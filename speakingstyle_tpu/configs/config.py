@@ -1,0 +1,285 @@
+"""Typed, validated configuration system.
+
+Replaces the reference's raw-YAML-triple plumbing (reference:
+train.py:176-200 passes three untyped dicts positionally) with frozen
+dataclasses. The three-file split (preprocess/model/train) and per-dataset
+presets are preserved so reference configs remain readable, but every key is
+schema-checked at load time — the config-drift crashes catalogued in
+SURVEY.md §2.5 become load-time errors here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import yaml
+
+PRESET_DIR = os.path.join(os.path.dirname(__file__), "presets")
+
+
+def _build(cls, data: Dict[str, Any], path: str = ""):
+    """Recursively build a dataclass from a nested dict, rejecting unknown keys."""
+    if data is None:
+        data = {}
+    import typing
+
+    hints = typing.get_type_hints(cls)
+    names = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(data) - names
+    if unknown:
+        raise ValueError(f"Unknown config keys at {path or cls.__name__}: {sorted(unknown)}")
+    kwargs = {}
+    for name in names:
+        if name not in data:
+            continue
+        value = data[name]
+        ftype = hints.get(name)
+        if dataclasses.is_dataclass(ftype) and isinstance(value, dict):
+            value = _build(ftype, value, f"{path}.{name}" if path else name)
+        kwargs[name] = value
+    return cls(**kwargs)
+
+
+# ---------------------------------------------------------------------------
+# preprocess.yaml
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class PathConfig:
+    corpus_path: str = ""
+    lexicon_path: str = ""
+    raw_path: str = ""
+    preprocessed_path: str = ""
+
+
+@dataclass(frozen=True)
+class TextConfig:
+    text_cleaners: List[str] = field(default_factory=lambda: ["english_cleaners"])
+    language: str = "en"
+
+
+@dataclass(frozen=True)
+class AudioConfig:
+    sampling_rate: int = 22050
+    max_wav_value: float = 32768.0
+
+
+@dataclass(frozen=True)
+class STFTConfig:
+    filter_length: int = 1024
+    hop_length: int = 256
+    win_length: int = 1024
+
+
+@dataclass(frozen=True)
+class MelConfig:
+    n_mel_channels: int = 80
+    mel_fmin: float = 0.0
+    mel_fmax: Optional[float] = 8000.0
+
+
+@dataclass(frozen=True)
+class VarianceFeatureConfig:
+    feature: str = "phoneme_level"  # or "frame_level"
+    normalization: bool = True
+
+    def __post_init__(self):
+        if self.feature not in ("phoneme_level", "frame_level"):
+            raise ValueError(f"feature must be phoneme_level|frame_level, got {self.feature}")
+
+
+@dataclass(frozen=True)
+class PreprocessingConfig:
+    val_size: int = 512
+    text: TextConfig = field(default_factory=TextConfig)
+    audio: AudioConfig = field(default_factory=AudioConfig)
+    stft: STFTConfig = field(default_factory=STFTConfig)
+    mel: MelConfig = field(default_factory=MelConfig)
+    pitch: VarianceFeatureConfig = field(default_factory=VarianceFeatureConfig)
+    energy: VarianceFeatureConfig = field(default_factory=VarianceFeatureConfig)
+
+
+@dataclass(frozen=True)
+class PreprocessConfig:
+    dataset: str = "LJSpeech"
+    path: PathConfig = field(default_factory=PathConfig)
+    preprocessing: PreprocessingConfig = field(default_factory=PreprocessingConfig)
+
+
+# ---------------------------------------------------------------------------
+# model.yaml
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    encoder_layer: int = 4
+    encoder_head: int = 2
+    encoder_hidden: int = 256
+    decoder_layer: int = 6
+    decoder_head: int = 2
+    decoder_hidden: int = 256
+    conv_filter_size: int = 1024
+    conv_kernel_size: Tuple[int, int] = (9, 1)
+    encoder_dropout: float = 0.2
+    decoder_dropout: float = 0.2
+
+
+@dataclass(frozen=True)
+class ReferenceEncoderConfig:
+    encoder_layer: int = 4
+    encoder_head: int = 8
+    encoder_hidden: int = 256
+    conv_layer: int = 3
+    conv_filter_size: int = 1024
+    conv_kernel_size: int = 3
+    dropout: float = 0.1
+
+
+@dataclass(frozen=True)
+class VariancePredictorConfig:
+    filter_size: int = 256
+    kernel_size: int = 3
+    dropout: float = 0.5
+
+
+@dataclass(frozen=True)
+class VarianceEmbeddingConfig:
+    pitch_quantization: str = "linear"  # "linear" | "log"
+    energy_quantization: str = "linear"
+    n_bins: int = 256
+
+    def __post_init__(self):
+        for q in (self.pitch_quantization, self.energy_quantization):
+            if q not in ("linear", "log"):
+                raise ValueError(f"quantization must be linear|log, got {q}")
+
+
+@dataclass(frozen=True)
+class VocoderConfig:
+    model: str = "HiFi-GAN"
+    speaker: str = "LJSpeech"
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    transformer: TransformerConfig = field(default_factory=TransformerConfig)
+    reference_encoder: ReferenceEncoderConfig = field(default_factory=ReferenceEncoderConfig)
+    variance_predictor: VariancePredictorConfig = field(default_factory=VariancePredictorConfig)
+    variance_embedding: VarianceEmbeddingConfig = field(default_factory=VarianceEmbeddingConfig)
+    multi_speaker: bool = False
+    max_seq_len: int = 1000
+    vocoder: VocoderConfig = field(default_factory=VocoderConfig)
+    # TPU-specific knobs (no reference counterpart):
+    compute_dtype: str = "bfloat16"  # activations/matmul dtype under jit
+    use_reference_encoder: bool = True
+
+
+# ---------------------------------------------------------------------------
+# train.yaml
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    batch_size: int = 16
+    betas: Tuple[float, float] = (0.9, 0.98)
+    eps: float = 1e-9
+    weight_decay: float = 0.0
+    grad_clip_thresh: float = 1.0
+    grad_acc_step: int = 1
+    warm_up_step: int = 4000  # vestigial in the reference; kept for config parity
+    anneal_steps: List[int] = field(default_factory=lambda: [300000, 400000, 500000])
+    anneal_rate: float = 0.3
+    init_lr: float = 1e-4
+    anneal_lr: float = 1e-3
+
+
+@dataclass(frozen=True)
+class StepConfig:
+    total_step: int = 900000
+    log_step: int = 100
+    synth_step: int = 1000
+    val_step: int = 1000
+    save_step: int = 1000
+
+
+@dataclass(frozen=True)
+class LossConfig:
+    lambda_f: float = 0.0  # FiLM-gate L2 weight (reference: model/loss.py:20,84-89)
+    anneal_steps: int = 10000  # LR ramp length (reference: model/optimizer.py:17,37-44)
+
+
+@dataclass(frozen=True)
+class TrainPathConfig:
+    ckpt_path: str = "./output/ckpt"
+    log_path: str = "./output/log"
+    result_path: str = "./output/result"
+
+
+@dataclass(frozen=True)
+class ShardingConfig:
+    """TPU mesh layout (no reference counterpart; replaces nn.DataParallel)."""
+
+    data_axis: int = -1  # -1: all devices on the data axis
+    model_axis: int = 1  # tensor-parallel degree (1 = pure DP)
+    remat: bool = False  # jax.checkpoint the FFT stacks
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    path: TrainPathConfig = field(default_factory=TrainPathConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    step: StepConfig = field(default_factory=StepConfig)
+    loss: LossConfig = field(default_factory=LossConfig)
+    sharding: ShardingConfig = field(default_factory=ShardingConfig)
+    ignore_layers: List[str] = field(default_factory=list)
+    seed: int = 1234
+
+
+@dataclass(frozen=True)
+class Config:
+    """The full (preprocess, model, train) triple."""
+
+    preprocess: PreprocessConfig = field(default_factory=PreprocessConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+
+
+def load_yaml(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return yaml.safe_load(f) or {}
+
+
+def load_config(
+    preprocess: Optional[str] = None,
+    model: Optional[str] = None,
+    train: Optional[str] = None,
+    preset: Optional[str] = None,
+) -> Config:
+    """Load a Config from explicit YAML paths and/or a named preset."""
+    if preset is not None:
+        base = os.path.join(PRESET_DIR, preset)
+        if not os.path.isdir(base):
+            raise ValueError(
+                f"Unknown preset {preset!r}; available: {sorted(os.listdir(PRESET_DIR))}"
+            )
+        preprocess = preprocess or os.path.join(base, "preprocess.yaml")
+        model = model or os.path.join(base, "model.yaml")
+        train = train or os.path.join(base, "train.yaml")
+    pc = _build(PreprocessConfig, load_yaml(preprocess)) if preprocess else PreprocessConfig()
+    mc = _build(ModelConfig, load_yaml(model)) if model else ModelConfig()
+    tc = _build(TrainConfig, load_yaml(train)) if train else TrainConfig()
+    return Config(preprocess=pc, model=mc, train=tc)
+
+
+def load_stats(preprocessed_path: str) -> Dict[str, List[float]]:
+    """stats.json: {"pitch": [min, max, mean, std], "energy": [...]}."""
+    with open(os.path.join(preprocessed_path, "stats.json")) as f:
+        return json.load(f)
+
+
+def asdict(cfg) -> Dict[str, Any]:
+    return dataclasses.asdict(cfg)

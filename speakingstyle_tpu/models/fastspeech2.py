@@ -1,0 +1,163 @@
+"""FastSpeech2 + style reference encoder (the flagship acoustic model).
+
+Wiring matches reference: model/fastspeech2.py:13-120 — reference-encoder
+FiLM vectors condition the encoder, decoder, and duration predictor;
+speaker embedding (if multi-speaker) is added to the encoder output;
+variance adaptor expands phonemes to frames; decoder + mel linear + postnet
+residual produce the mel pair.
+
+All shapes are static: callers pass bucketed [B, L_src] tokens and a fixed
+``max_mel_len``; teacher-forced vs free-running are two traces
+distinguished by whether targets are None.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.models.postnet import PostNet
+from speakingstyle_tpu.models.reference_encoder import ReferenceEncoder
+from speakingstyle_tpu.models.transformer import Decoder, Encoder
+from speakingstyle_tpu.models.variance_adaptor import VarianceAdaptor
+from speakingstyle_tpu.ops.masking import length_to_mask
+
+
+class FastSpeech2(nn.Module):
+    config: Config
+    pitch_stats: tuple = (-3.0, 12.0)  # (min, max) from stats.json
+    energy_stats: tuple = (-2.0, 10.0)
+    n_speakers: int = 1
+    n_position: Optional[int] = None  # override for long-sequence inference
+
+    @nn.compact
+    def __call__(
+        self,
+        speakers,          # [B] int
+        texts,             # [B, L_src] int
+        src_lens,          # [B] int
+        mels=None,         # [B, T_mel, n_mels] reference/target mel
+        mel_lens=None,     # [B] int
+        max_mel_len: Optional[int] = None,
+        p_targets=None,
+        e_targets=None,
+        d_targets=None,
+        p_control: float = 1.0,
+        e_control: float = 1.0,
+        d_control: float = 1.0,
+        deterministic: bool = True,
+    ):
+        cfg = self.config.model
+        tf = cfg.transformer
+        dtype = jnp.dtype(cfg.compute_dtype)
+        n_position = self.n_position or (cfg.max_seq_len + 1)
+
+        B, L_src = texts.shape
+        src_pad_mask = length_to_mask(src_lens, L_src)
+        mel_pad_mask = (
+            length_to_mask(mel_lens, mels.shape[1]) if mel_lens is not None else None
+        )
+
+        gammas = betas = None
+        if cfg.use_reference_encoder:
+            ref = cfg.reference_encoder
+            gammas, betas = ReferenceEncoder(
+                n_conv_layers=ref.conv_layer,
+                conv_filter_size=ref.conv_filter_size,
+                conv_kernel_size=ref.conv_kernel_size,
+                n_layers=ref.encoder_layer,
+                n_head=ref.encoder_head,
+                d_model=ref.encoder_hidden,
+                dropout=ref.dropout,
+                n_position=n_position,
+                dtype=dtype,
+                name="reference_encoder",
+            )(mels, mel_pad_mask, deterministic=deterministic)
+
+        x = Encoder(
+            n_layers=tf.encoder_layer,
+            d_model=tf.encoder_hidden,
+            n_head=tf.encoder_head,
+            d_inner=tf.conv_filter_size,
+            kernel_sizes=tuple(tf.conv_kernel_size),
+            dropout=tf.encoder_dropout,
+            n_position=n_position,
+            remat=self.config.train.sharding.remat,
+            dtype=dtype,
+            name="encoder",
+        )(texts, src_pad_mask, gammas, betas, deterministic=deterministic)
+
+        if cfg.multi_speaker:
+            spk = nn.Embed(
+                self.n_speakers, tf.encoder_hidden, dtype=dtype, name="speaker_emb"
+            )(speakers)
+            x = x + spk[:, None, :]
+
+        va = VarianceAdaptor(
+            pitch_stats=tuple(self.pitch_stats),
+            energy_stats=tuple(self.energy_stats),
+            n_bins=cfg.variance_embedding.n_bins,
+            pitch_quantization=cfg.variance_embedding.pitch_quantization,
+            energy_quantization=cfg.variance_embedding.energy_quantization,
+            pitch_feature_level=self.config.preprocess.preprocessing.pitch.feature,
+            energy_feature_level=self.config.preprocess.preprocessing.energy.feature,
+            d_model=tf.encoder_hidden,
+            filter_size=cfg.variance_predictor.filter_size,
+            kernel_size=cfg.variance_predictor.kernel_size,
+            dropout=cfg.variance_predictor.dropout,
+            dtype=dtype,
+            name="variance_adaptor",
+        )(
+            x,
+            src_pad_mask,
+            mel_pad_mask,
+            max_mel_len,
+            p_targets,
+            e_targets,
+            d_targets,
+            p_control,
+            e_control,
+            d_control,
+            gammas,
+            betas,
+            deterministic=deterministic,
+        )
+
+        dec = Decoder(
+            n_layers=tf.decoder_layer,
+            d_model=tf.decoder_hidden,
+            n_head=tf.decoder_head,
+            d_inner=tf.conv_filter_size,
+            kernel_sizes=tuple(tf.conv_kernel_size),
+            dropout=tf.decoder_dropout,
+            n_position=n_position,
+            remat=self.config.train.sharding.remat,
+            dtype=dtype,
+            name="decoder",
+        )(va["features"], va["mel_pad_mask"], gammas, betas, deterministic=deterministic)
+
+        mel_out = nn.Dense(
+            self.config.preprocess.preprocessing.mel.n_mel_channels,
+            dtype=dtype,
+            name="mel_linear",
+        )(dec)
+        postnet_residual = PostNet(
+            n_mel_channels=self.config.preprocess.preprocessing.mel.n_mel_channels,
+            dtype=dtype,
+            name="postnet",
+        )(mel_out, deterministic=deterministic)
+        mel_postnet = mel_out + postnet_residual
+
+        return {
+            "mel": mel_out.astype(jnp.float32),
+            "mel_postnet": mel_postnet.astype(jnp.float32),
+            "pitch_prediction": va["pitch_prediction"],
+            "energy_prediction": va["energy_prediction"],
+            "log_duration_prediction": va["log_duration_prediction"],
+            "durations": va["durations"],
+            "src_pad_mask": src_pad_mask,
+            "mel_pad_mask": va["mel_pad_mask"],
+            "src_lens": src_lens,
+            "mel_lens": va["mel_lens"],
+        }

@@ -1,0 +1,179 @@
+"""Core Flax layers: post-LN transformer FFT block and conv primitives.
+
+Behavioral spec comes from the reference transformer stack
+(reference: transformer/SubLayers.py:8-93, transformer/Layers.py:11-37):
+post-LN residual order, scaled dot-product attention with sqrt(d_k)
+temperature, conv1d position-wise FFN with kernels (9, 1), masked fills
+after attention and after the FFN. TPU-first choices: batched [B, H, L, D]
+einsum attention (no (n_head*B) reshape games), f32 softmax under a
+bfloat16 compute dtype, additive finite mask bias instead of -inf fills.
+
+LayerNorm epsilon is 1e-5 everywhere (torch default) for checkpoint parity.
+"""
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from speakingstyle_tpu.ops.masking import attention_bias, mask_fill
+
+LN_EPS = 1e-5
+
+
+class FiLM(nn.Module):
+    """Feature-wise linear modulation with learned scalar gates.
+
+    ``y = (s_gamma * gamma + 1) * x + s_beta * beta`` where s_gamma/s_beta are
+    per-site scalars initialized to 1 and L2-regularized by the loss
+    (reference: model/blocks.py:43-62, model/loss.py:84-89). Parameter names
+    ``s_gamma``/``s_beta`` are load-bearing: the loss collects them by name.
+    """
+
+    @nn.compact
+    def __call__(self, x, gammas, betas):
+        s_gamma = self.param("s_gamma", nn.initializers.ones, (1,))
+        s_beta = self.param("s_beta", nn.initializers.ones, (1,))
+        g = (s_gamma * gammas).astype(x.dtype)
+        b = (s_beta * betas).astype(x.dtype)
+        return (g + 1.0) * x + b
+
+
+class MultiHeadSelfAttention(nn.Module):
+    """Post-LN multi-head self-attention (reference: transformer/SubLayers.py:8-57)."""
+
+    n_head: int
+    d_model: int
+    dropout: float
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, pad_mask, deterministic: bool):
+        d_head = self.d_model // self.n_head
+        residual = x
+        dense = lambda name: nn.Dense(self.d_model, dtype=self.dtype, name=name)
+        B, L, _ = x.shape
+        q = dense("w_qs")(x).reshape(B, L, self.n_head, d_head)
+        k = dense("w_ks")(x).reshape(B, L, self.n_head, d_head)
+        v = dense("w_vs")(x).reshape(B, L, self.n_head, d_head)
+
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+            jnp.asarray(d_head, jnp.float32)
+        ).astype(self.dtype)
+        logits = logits.astype(jnp.float32) + attention_bias(pad_mask, jnp.float32)
+        attn = nn.softmax(logits, axis=-1).astype(self.dtype)
+
+        out = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(B, L, self.d_model)
+        out = nn.Dense(self.d_model, dtype=self.dtype, name="fc")(out)
+        out = nn.Dropout(self.dropout)(out, deterministic=deterministic)
+        out = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype, name="layer_norm")(
+            out + residual
+        )
+        return out
+
+
+class ConvFFN(nn.Module):
+    """Position-wise conv feed-forward (reference: transformer/SubLayers.py:60-93)."""
+
+    d_model: int
+    d_inner: int
+    kernel_sizes: Tuple[int, int]
+    dropout: float
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool):
+        residual = x
+        h = nn.Conv(
+            self.d_inner,
+            kernel_size=(self.kernel_sizes[0],),
+            padding="SAME",
+            dtype=self.dtype,
+            name="w_1",
+        )(x)
+        h = nn.relu(h)
+        h = nn.Conv(
+            self.d_model,
+            kernel_size=(self.kernel_sizes[1],),
+            padding="SAME",
+            dtype=self.dtype,
+            name="w_2",
+        )(h)
+        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        return nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype, name="layer_norm")(
+            h + residual
+        )
+
+
+class FFTBlock(nn.Module):
+    """Self-attention + conv FFN + optional FiLM (reference: transformer/Layers.py:11-37).
+
+    FiLM is applied after the FFN, then padded steps are re-zeroed. The
+    ``film`` flag controls whether the gate params exist at all (the
+    reference encoder's blocks have none, reference: model/modules.py:380).
+    """
+
+    d_model: int
+    n_head: int
+    d_inner: int
+    kernel_sizes: Tuple[int, int]
+    dropout: float
+    film: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, pad_mask, gammas=None, betas=None, deterministic=True):
+        x = MultiHeadSelfAttention(
+            self.n_head, self.d_model, self.dropout, dtype=self.dtype, name="slf_attn"
+        )(x, pad_mask, deterministic)
+        x = mask_fill(x, pad_mask)
+        x = ConvFFN(
+            self.d_model,
+            self.d_inner,
+            self.kernel_sizes,
+            self.dropout,
+            dtype=self.dtype,
+            name="pos_ffn",
+        )(x, deterministic)
+        if self.film and gammas is not None and betas is not None:
+            x = FiLM(name="film")(x, gammas, betas)
+        x = mask_fill(x, pad_mask)
+        return x
+
+
+class ConvNorm(nn.Module):
+    """1-D conv over time, channel-last (reference: transformer/Layers.py:40-74)."""
+
+    out_channels: int
+    kernel_size: int = 1
+    dilation: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Conv(
+            self.out_channels,
+            kernel_size=(self.kernel_size,),
+            kernel_dilation=(self.dilation,),
+            padding="SAME",
+            dtype=self.dtype,
+            name="conv",
+        )(x)
+
+
+class LinearNorm(nn.Module):
+    """Bias-free xavier-initialized projection (reference: model/blocks.py:66-79)."""
+
+    out_features: int
+    use_bias: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        return nn.Dense(
+            self.out_features,
+            use_bias=self.use_bias,
+            kernel_init=nn.initializers.xavier_uniform(),
+            dtype=self.dtype,
+            name="linear",
+        )(x)

@@ -1,0 +1,83 @@
+"""FastSpeech2 loss (reference: model/loss.py:5-99).
+
+L1 on mel and postnet-mel, MSE on pitch/energy/log-duration — each averaged
+over real (unmasked) elements only, reproducing the reference's
+``masked_select(...).mean()`` with jit-friendly masked means — plus the
+FiLM-gate L2 term ``lambda_f * sum(s_gamma^2 + s_beta^2)`` collected from
+the parameter tree by name (reference: utils/model.py:53-59).
+"""
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from speakingstyle_tpu.ops.masking import masked_mean
+
+
+def film_gate_l2(params) -> jnp.ndarray:
+    """Sum of squares of every s_gamma/s_beta scalar in the tree."""
+    total = jnp.zeros((), jnp.float32)
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    for path, leaf in flat:
+        names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        if any(n in ("s_gamma", "s_beta") for n in names):
+            total = total + jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+    return total
+
+
+def fastspeech2_loss(
+    predictions: Dict[str, Any],
+    mel_targets,
+    pitch_targets,
+    energy_targets,
+    duration_targets,
+    params,
+    lambda_f: float = 0.0,
+    pitch_feature_level: str = "phoneme_level",
+    energy_feature_level: str = "phoneme_level",
+) -> Dict[str, jnp.ndarray]:
+    src_keep = ~predictions["src_pad_mask"]
+    mel_keep = ~predictions["mel_pad_mask"]
+
+    log_duration_targets = jnp.log(duration_targets.astype(jnp.float32) + 1.0)
+
+    pitch_keep = src_keep if pitch_feature_level == "phoneme_level" else mel_keep
+    energy_keep = src_keep if energy_feature_level == "phoneme_level" else mel_keep
+
+    mel_keep3 = mel_keep[..., None]
+    mel_targets = mel_targets.astype(jnp.float32)
+    mel_loss = masked_mean(
+        jnp.abs(predictions["mel"] - mel_targets), jnp.broadcast_to(mel_keep3, mel_targets.shape)
+    )
+    postnet_mel_loss = masked_mean(
+        jnp.abs(predictions["mel_postnet"] - mel_targets),
+        jnp.broadcast_to(mel_keep3, mel_targets.shape),
+    )
+    pitch_loss = masked_mean(
+        jnp.square(predictions["pitch_prediction"] - pitch_targets.astype(jnp.float32)),
+        pitch_keep,
+    )
+    energy_loss = masked_mean(
+        jnp.square(predictions["energy_prediction"] - energy_targets.astype(jnp.float32)),
+        energy_keep,
+    )
+    duration_loss = masked_mean(
+        jnp.square(predictions["log_duration_prediction"] - log_duration_targets),
+        src_keep,
+    )
+    scale_reg = film_gate_l2(params)
+
+    total = (
+        mel_loss + postnet_mel_loss + duration_loss + pitch_loss + energy_loss
+        + lambda_f * scale_reg
+    )
+    return {
+        "total_loss": total,
+        "mel_loss": mel_loss,
+        "postnet_mel_loss": postnet_mel_loss,
+        "pitch_loss": pitch_loss,
+        "energy_loss": energy_loss,
+        "duration_loss": duration_loss,
+        "film_gate_l2": scale_reg,
+    }

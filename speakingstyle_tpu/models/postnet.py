@@ -1,0 +1,46 @@
+"""PostNet mel refiner: 5 conv1d(512, k=5) + BatchNorm, tanh on all but last.
+
+Reference: transformer/Layers.py:78-148. BatchNorm note (SURVEY.md §7 hard
+part 6): under jit with a batch-sharded input, the batch-mean reduction is a
+global XLA collective — cross-device-synced batch stats come for free (the
+reference's nn.DataParallel computed per-replica stats; synced stats are
+strictly better behaved).
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class PostNet(nn.Module):
+    n_mel_channels: int = 80
+    embedding_dim: int = 512
+    kernel_size: int = 5
+    n_convolutions: int = 5
+    dropout: float = 0.5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, mel, deterministic=True):
+        """mel: [B, T, n_mels] -> residual [B, T, n_mels]."""
+        x = mel.astype(self.dtype)
+        for i in range(self.n_convolutions):
+            is_last = i == self.n_convolutions - 1
+            out_ch = self.n_mel_channels if is_last else self.embedding_dim
+            x = nn.Conv(
+                out_ch,
+                kernel_size=(self.kernel_size,),
+                padding="SAME",
+                dtype=self.dtype,
+                name=f"conv_{i}",
+            )(x)
+            x = nn.BatchNorm(
+                use_running_average=deterministic,
+                momentum=0.9,
+                epsilon=1e-5,
+                dtype=self.dtype,
+                name=f"bn_{i}",
+            )(x)
+            if not is_last:
+                x = jnp.tanh(x)
+            x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
+        return x

@@ -1,0 +1,83 @@
+"""Style reference encoder: mel -> FiLM conditioning vectors (gamma, beta).
+
+Reference: model/modules.py:307-406. Pipeline: 3x(conv k=3 + ReLU + LN +
+dropout) over the mel, padded steps zeroed, sinusoid PE, 1024->256
+projection, 4 FFT blocks (8 heads, no FiLM), time mean-pool, 256->512
+affine, split into gamma/beta [B, 1, 256].
+
+Parity note: the reference mean-pools with ``mean(dim=1)`` over the padded
+length — padded frames are zeros but still count in the denominator. We
+reproduce that exactly (``true_length_mean=False``); flip the flag for a
+mathematically clean mean when training from scratch.
+"""
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from speakingstyle_tpu.models.layers import ConvNorm, FFTBlock, LinearNorm, LN_EPS
+from speakingstyle_tpu.ops.masking import mask_fill
+from speakingstyle_tpu.ops.positional import add_position_encoding
+
+
+class ReferenceEncoder(nn.Module):
+    n_conv_layers: int = 3
+    conv_filter_size: int = 1024
+    conv_kernel_size: int = 3
+    n_layers: int = 4
+    n_head: int = 8
+    d_model: int = 256
+    dropout: float = 0.1
+    n_position: int = 1001
+    true_length_mean: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, mel, pad_mask, deterministic=True):
+        """mel: [B, T, n_mels]; pad_mask: [B, T] True at padding.
+
+        Returns (gammas, betas), each [B, 1, d_model].
+        """
+        x = mel.astype(self.dtype)
+        for i in range(self.n_conv_layers):
+            x = ConvNorm(
+                self.conv_filter_size,
+                kernel_size=self.conv_kernel_size,
+                dtype=self.dtype,
+                name=f"conv_{i}",
+            )(x)
+            x = nn.relu(x)
+            x = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype, name=f"ln_{i}")(x)
+            x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
+        x = mask_fill(x, pad_mask)
+
+        x = add_position_encoding(x, self.n_position)
+
+        x = LinearNorm(self.d_model, dtype=self.dtype, name="fftb_linear")(x)
+        for i in range(self.n_layers):
+            x = FFTBlock(
+                d_model=self.d_model,
+                n_head=self.n_head,
+                d_inner=self.conv_filter_size,
+                kernel_sizes=(self.conv_kernel_size, self.conv_kernel_size),
+                dropout=self.dropout,
+                film=False,
+                dtype=self.dtype,
+                name=f"fftb_{i}",
+            )(x, pad_mask, deterministic=deterministic)
+
+        if self.true_length_mean:
+            keep = (~pad_mask).astype(x.dtype)[..., None]
+            pooled = (x * keep).sum(axis=1, keepdims=True) / jnp.maximum(
+                keep.sum(axis=1, keepdims=True), 1.0
+            )
+        else:
+            # reference semantics: zeros at padding, denominator = padded length
+            pooled = x.mean(axis=1, keepdims=True)
+
+        affine = LinearNorm(2 * self.d_model, dtype=self.dtype, name="feature_wise_affine")(
+            pooled
+        )
+        gammas, betas = jnp.split(affine, 2, axis=-1)
+        return gammas, betas

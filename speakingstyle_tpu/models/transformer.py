@@ -1,0 +1,124 @@
+"""Phoneme encoder and mel decoder: sinusoid PE + FFT block stacks.
+
+Reference: transformer/Models.py:33-170. Differences by design:
+- The PE table is sized at construction (``n_position``) and baked into the
+  compiled program; long-sequence inference sizes the table up instead of
+  recomputing it on host per call (reference: Models.py:82-87).
+- Shapes are static: callers present bucketed [B, L] inputs with pad masks;
+  the decoder's train-time truncation to max_seq_len becomes a structural
+  guarantee (buckets never exceed the table).
+- Optional jax.checkpoint (remat) over the block stack trades FLOPs for HBM.
+"""
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from speakingstyle_tpu.ops.positional import add_position_encoding
+from speakingstyle_tpu.models.layers import FFTBlock
+from speakingstyle_tpu.text.symbols import VOCAB_SIZE
+
+
+class FFTStack(nn.Module):
+    """N FiLM-modulated FFT blocks with a fixed sinusoid PE prologue."""
+
+    n_layers: int
+    d_model: int
+    n_head: int
+    d_inner: int
+    kernel_sizes: Tuple[int, int]
+    dropout: float
+    n_position: int
+    film: bool = True
+    remat: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, pad_mask, gammas=None, betas=None, deterministic=True):
+        x = add_position_encoding(x, self.n_position)
+        block_cls = FFTBlock
+        if self.remat:
+            # flax lifts __call__(self, x, pad_mask, gammas, betas, deterministic)
+            # with self at index 0 — `deterministic` is arg 5.
+            block_cls = nn.remat(FFTBlock, static_argnums=(5,))
+        for i in range(self.n_layers):
+            x = block_cls(
+                d_model=self.d_model,
+                n_head=self.n_head,
+                d_inner=self.d_inner,
+                kernel_sizes=self.kernel_sizes,
+                dropout=self.dropout,
+                film=self.film,
+                dtype=self.dtype,
+                name=f"layer_{i}",
+            )(x, pad_mask, gammas, betas, deterministic)
+        return x
+
+
+class Encoder(nn.Module):
+    """Phoneme embedding + FFT stack (reference: transformer/Models.py:33-101)."""
+
+    n_layers: int = 4
+    d_model: int = 256
+    n_head: int = 2
+    d_inner: int = 1024
+    kernel_sizes: Tuple[int, int] = (9, 1)
+    dropout: float = 0.2
+    n_position: int = 1001
+    vocab_size: int = VOCAB_SIZE
+    remat: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, token_ids, pad_mask, gammas=None, betas=None, deterministic=True):
+        x = nn.Embed(
+            self.vocab_size,
+            self.d_model,
+            dtype=self.dtype,
+            name="src_word_emb",
+        )(token_ids)
+        return FFTStack(
+            self.n_layers,
+            self.d_model,
+            self.n_head,
+            self.d_inner,
+            self.kernel_sizes,
+            self.dropout,
+            self.n_position,
+            film=True,
+            remat=self.remat,
+            dtype=self.dtype,
+            name="layer_stack",
+        )(x, pad_mask, gammas, betas, deterministic)
+
+
+class Decoder(nn.Module):
+    """Frame-level FFT stack (reference: transformer/Models.py:104-170)."""
+
+    n_layers: int = 6
+    d_model: int = 256
+    n_head: int = 2
+    d_inner: int = 1024
+    kernel_sizes: Tuple[int, int] = (9, 1)
+    dropout: float = 0.2
+    n_position: int = 1001
+    remat: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, pad_mask, gammas=None, betas=None, deterministic=True):
+        return FFTStack(
+            self.n_layers,
+            self.d_model,
+            self.n_head,
+            self.d_inner,
+            self.kernel_sizes,
+            self.dropout,
+            self.n_position,
+            film=True,
+            remat=self.remat,
+            dtype=self.dtype,
+            name="layer_stack",
+        )(x, pad_mask, gammas, betas, deterministic)

@@ -1,0 +1,161 @@
+"""Variance adaptor: duration/pitch/energy predictors + length regulation.
+
+Reference: model/modules.py:20-305. As-implemented quirks reproduced on
+purpose (checkpoint parity — SURVEY.md §7 hard part 4):
+- FiLM conditioning reaches ONLY the duration predictor; the pitch and
+  energy predictor calls omit gamma/beta (reference: model/modules.py:121-131).
+- Bucket boundaries are n_bins-1 values, torch.bucketize 'left' semantics.
+
+TPU-first change: the length regulator is the padded-gather op in
+``ops/length_regulator.py`` rather than a per-token Python loop.
+"""
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from speakingstyle_tpu.models.layers import FiLM, LN_EPS
+from speakingstyle_tpu.ops.length_regulator import length_regulate, predicted_durations
+from speakingstyle_tpu.ops.quantize import bucketize
+
+
+class VariancePredictor(nn.Module):
+    """2x(conv k=3 + ReLU + LN + dropout) -> optional FiLM -> linear -> scalar.
+
+    Reference: model/modules.py:204-259.
+    """
+
+    filter_size: int = 256
+    kernel_size: int = 3
+    dropout: float = 0.5
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, pad_mask, gammas=None, betas=None, deterministic=True):
+        for i in (1, 2):
+            x = nn.Conv(
+                self.filter_size,
+                kernel_size=(self.kernel_size,),
+                padding="SAME",
+                dtype=self.dtype,
+                name=f"conv1d_{i}",
+            )(x)
+            x = nn.relu(x)
+            x = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype, name=f"layer_norm_{i}")(x)
+            x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
+        if gammas is not None and betas is not None:
+            x = FiLM(name="film")(x, gammas, betas)
+        out = nn.Dense(1, dtype=self.dtype, name="linear_layer")(x)[..., 0]
+        return jnp.where(pad_mask, 0.0, out.astype(jnp.float32))
+
+
+class VarianceAdaptor(nn.Module):
+    """Reference: model/modules.py:20-165.
+
+    ``pitch_stats``/``energy_stats`` are (min, max) from stats.json; bins are
+    baked in as compile-time constants.
+    """
+
+    pitch_stats: Tuple[float, float] = (-2.0, 10.0)
+    energy_stats: Tuple[float, float] = (-2.0, 10.0)
+    n_bins: int = 256
+    pitch_quantization: str = "linear"
+    energy_quantization: str = "linear"
+    pitch_feature_level: str = "phoneme_level"
+    energy_feature_level: str = "phoneme_level"
+    d_model: int = 256
+    filter_size: int = 256
+    kernel_size: int = 3
+    dropout: float = 0.5
+    dtype: jnp.dtype = jnp.float32
+
+    def _bins(self, stats, quantization):
+        from speakingstyle_tpu.ops.quantize import make_bins
+
+        return make_bins(stats[0], stats[1], self.n_bins, quantization)
+
+    @nn.compact
+    def __call__(
+        self,
+        x,
+        src_pad_mask,
+        mel_pad_mask=None,
+        max_mel_len: Optional[int] = None,
+        pitch_target=None,
+        energy_target=None,
+        duration_target=None,
+        p_control: float = 1.0,
+        e_control: float = 1.0,
+        d_control: float = 1.0,
+        gammas=None,
+        betas=None,
+        deterministic: bool = True,
+    ):
+        mk_pred = lambda name: VariancePredictor(
+            self.filter_size, self.kernel_size, self.dropout, dtype=self.dtype, name=name
+        )
+        embed = lambda name: nn.Embed(self.n_bins, self.d_model, dtype=self.dtype, name=name)
+
+        log_d_pred = mk_pred("duration_predictor")(
+            x, src_pad_mask, gammas, betas, deterministic
+        )
+
+        pitch_bins = self._bins(self.pitch_stats, self.pitch_quantization)
+        energy_bins = self._bins(self.energy_stats, self.energy_quantization)
+        pitch_embedding = embed("pitch_embedding")
+        energy_embedding = embed("energy_embedding")
+
+        def variance(pred_name, emb, bins, target, mask, control):
+            # FiLM deliberately NOT passed (reference: model/modules.py:122-131)
+            pred = mk_pred(pred_name)(x, mask, None, None, deterministic)
+            if target is not None:
+                e = emb(bucketize(target, bins))
+            else:
+                pred = pred * control
+                e = emb(bucketize(pred, bins))
+            return pred, e
+
+        p_pred = e_pred = None
+        if self.pitch_feature_level == "phoneme_level":
+            p_pred, p_emb = variance(
+                "pitch_predictor", pitch_embedding, pitch_bins,
+                pitch_target, src_pad_mask, p_control,
+            )
+            x = x + p_emb
+        if self.energy_feature_level == "phoneme_level":
+            e_pred, e_emb = variance(
+                "energy_predictor", energy_embedding, energy_bins,
+                energy_target, src_pad_mask, e_control,
+            )
+            x = x + e_emb
+
+        if duration_target is not None:
+            durations = duration_target
+        else:
+            durations = predicted_durations(log_d_pred, src_pad_mask, d_control)
+        x, mel_lens, mel_pad_mask = length_regulate(x, durations, max_mel_len)
+
+        if self.pitch_feature_level == "frame_level":
+            p_pred, p_emb = variance(
+                "pitch_predictor", pitch_embedding, pitch_bins,
+                pitch_target, mel_pad_mask, p_control,
+            )
+            x = x + p_emb
+        if self.energy_feature_level == "frame_level":
+            e_pred, e_emb = variance(
+                "energy_predictor", energy_embedding, energy_bins,
+                energy_target, mel_pad_mask, e_control,
+            )
+            x = x + e_emb
+
+        return {
+            "features": x,
+            "pitch_prediction": p_pred,
+            "energy_prediction": e_pred,
+            "log_duration_prediction": log_d_pred,
+            "durations": durations,
+            "mel_lens": mel_lens,
+            "mel_pad_mask": mel_pad_mask,
+        }

@@ -1,0 +1,61 @@
+"""Jit-traceable length regulation (phoneme -> frame expansion).
+
+The reference expands each phoneme vector `duration[i]` times with a
+per-batch-item, per-phoneme Python loop of ``Tensor.expand`` + ``torch.cat``
+(reference: model/modules.py:168-201) — host-bound and untraceable. Here the
+expansion is a single batched gather:
+
+    ends[i]      = cumsum(durations)[i]           (frame index where phone i ends)
+    frame_to_ph  = searchsorted(ends, t, 'right') (phone owning frame t)
+    out[t]       = x[frame_to_ph[t]]
+
+All shapes static; frames beyond sum(durations) are masked out. This is the
+single most important TPU-side design change (SURVEY.md §7 step 4).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from speakingstyle_tpu.ops.masking import length_to_mask
+
+
+def length_regulate(x, durations, max_mel_len):
+    """Expand phoneme-level features to frame level.
+
+    Args:
+      x: [B, L_src, H] phoneme-level features.
+      durations: [B, L_src] integer frame counts (>= 0).
+      max_mel_len: static output length (frames past the true length are 0).
+
+    Returns:
+      (frames [B, max_mel_len, H], mel_lens [B], mel_pad_mask [B, max_mel_len])
+    """
+    durations = durations.astype(jnp.int32)
+    ends = jnp.cumsum(durations, axis=1)  # [B, L_src]
+    mel_lens = ends[:, -1]
+    frame_idx = jnp.arange(max_mel_len, dtype=jnp.int32)
+
+    # frame t belongs to the first phone whose end is > t
+    frame_to_ph = jax.vmap(
+        lambda e: jnp.searchsorted(e, frame_idx, side="right")
+    )(ends).astype(jnp.int32)
+    frame_to_ph = jnp.minimum(frame_to_ph, x.shape[1] - 1)
+
+    frames = jnp.take_along_axis(x, frame_to_ph[..., None], axis=1)
+    mel_lens = jnp.minimum(mel_lens, max_mel_len)
+    pad_mask = length_to_mask(mel_lens, max_mel_len)
+    frames = jnp.where(pad_mask[..., None], 0.0, frames)
+    return frames, mel_lens, pad_mask
+
+
+def predicted_durations(log_duration_pred, src_pad_mask, d_control=1.0):
+    """Free-running durations: round(exp(logd) - 1) * control, clamped at 0.
+
+    Matches reference: model/modules.py:137-144 (note the reference rounds
+    *before* scaling by d_control and clamps after; we reproduce that order).
+    Padded source positions get duration 0.
+    """
+    d = jnp.round(jnp.exp(log_duration_pred) - 1.0) * d_control
+    d = jnp.clip(d, 0.0, None)
+    d = jnp.where(src_pad_mask, 0.0, d)
+    return d.astype(jnp.int32)

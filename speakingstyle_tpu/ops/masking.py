@@ -1,0 +1,43 @@
+"""Mask construction helpers.
+
+Convention matches the reference (reference: utils/tools.py:110-118):
+masks are True at PADDING positions. All shapes are static under jit;
+lengths are traced values.
+"""
+
+import jax.numpy as jnp
+
+
+def length_to_mask(lengths, max_len):
+    """[B] lengths -> [B, max_len] bool mask, True where position >= length."""
+    ids = jnp.arange(max_len, dtype=lengths.dtype)[None, :]
+    return ids >= lengths[:, None]
+
+
+def attention_bias(pad_mask, dtype=jnp.float32):
+    """[B, L] padding mask -> [B, 1, 1, L] additive bias for attention logits.
+
+    Padded keys get a large negative bias (not -inf: on padded *query* rows
+    every key would be -inf and softmax would produce NaNs; the reference
+    relies on downstream masked_fill to hide those NaN rows, we keep the
+    whole graph finite instead).
+    """
+    neg = jnp.asarray(jnp.finfo(dtype).min / 2, dtype)
+    return jnp.where(pad_mask[:, None, None, :], neg, jnp.zeros((), dtype))
+
+
+def mask_fill(x, pad_mask, value=0.0):
+    """Zero (or fill) padded time steps. x: [B, L, H], pad_mask: [B, L]."""
+    return jnp.where(pad_mask[..., None], jnp.asarray(value, x.dtype), x)
+
+
+def masked_mean(values, keep_mask):
+    """Mean of `values` over positions where keep_mask is True.
+
+    Equivalent to the reference's ``masked_select(...).mean()`` pattern
+    (reference: model/loss.py:55-82) but jit-friendly.
+    """
+    keep = keep_mask.astype(values.dtype)
+    total = jnp.sum(values * keep)
+    count = jnp.maximum(jnp.sum(keep), 1.0)
+    return total / count

@@ -1,0 +1,34 @@
+"""Sinusoidal position encoding (reference: transformer/Models.py:10-30).
+
+Computed once in numpy at module-construction time and baked into the
+compiled program as a constant — never recomputed on host at step time
+(the reference recomputes it per call for long sequences,
+transformer/Models.py:82-87; we size the table up front instead).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def add_position_encoding(x, n_position: int):
+    """Add the sinusoid table to [B, L, H] features; L must fit the table."""
+    L, d = x.shape[1], x.shape[2]
+    if L > n_position:
+        raise ValueError(
+            f"sequence length {L} exceeds position table {n_position}; "
+            "enlarge max_seq_len / n_position for long inference"
+        )
+    pe = sinusoid_position_table(n_position, d)[:L]
+    return x + jnp.asarray(pe, x.dtype)[None, :, :]
+
+
+def sinusoid_position_table(n_position: int, d_hid: int) -> np.ndarray:
+    """[n_position, d_hid] float32 table; even dims sin, odd dims cos."""
+    positions = np.arange(n_position, dtype=np.float64)[:, None]
+    dim_idx = np.arange(d_hid, dtype=np.float64)[None, :]
+    angle_rates = 1.0 / np.power(10000.0, 2.0 * (np.floor(dim_idx / 2.0)) / d_hid)
+    angles = positions * angle_rates
+    table = np.empty((n_position, d_hid), dtype=np.float64)
+    table[:, 0::2] = np.sin(angles[:, 0::2])
+    table[:, 1::2] = np.cos(angles[:, 1::2])
+    return table.astype(np.float32)

@@ -1,0 +1,29 @@
+"""Pitch/energy bucketization (reference: model/modules.py:85-103).
+
+``torch.bucketize(v, bins)`` (right=False) == ``searchsorted(bins, v,
+side='left')`` — verified empirically; note this is NOT ``jnp.digitize``,
+which uses side='right'. Bins are ``n_bins - 1`` boundaries, linear or log
+spaced from stats.json min/max.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_bins(vmin: float, vmax: float, n_bins: int, quantization: str) -> np.ndarray:
+    """[n_bins - 1] boundaries; log spacing only valid for unnormalized stats."""
+    if quantization == "log":
+        if vmin <= 0:
+            raise ValueError(
+                f"log quantization needs positive stats, got min={vmin}; "
+                "z-normalized features require 'linear' (see config comment)"
+            )
+        return np.exp(
+            np.linspace(np.log(vmin), np.log(vmax), n_bins - 1, dtype=np.float64)
+        ).astype(np.float32)
+    return np.linspace(vmin, vmax, n_bins - 1, dtype=np.float32)
+
+
+def bucketize(values, bins):
+    """Map continuous values to bucket ids in [0, len(bins)]."""
+    return jnp.searchsorted(jnp.asarray(bins), values, side="left").astype(jnp.int32)

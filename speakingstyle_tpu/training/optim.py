@@ -1,0 +1,49 @@
+"""Optimizer: Adam + ramp-then-step-decay schedule, grad clip, accumulation.
+
+Reference semantics (reference: model/optimizer.py:35-44): during the first
+``loss.anneal_steps`` steps LR ramps linearly init_lr -> anneal_lr; after
+that, LR = anneal_lr scaled by anneal_rate for every optimizer.anneal_steps
+milestone passed. The lr for step s uses ``current_step = s + 1``
+(step_and_update_lr increments before reading).
+
+Built as an optax chain: clip_by_global_norm(1.0) -> adam(b1=0.9, b2=0.98,
+eps=1e-9) -> schedule; grad accumulation via optax.MultiSteps.
+"""
+
+import jax.numpy as jnp
+import optax
+
+from speakingstyle_tpu.configs.config import TrainConfig
+
+
+def make_lr_schedule(train_cfg: TrainConfig):
+    opt = train_cfg.optimizer
+    ramp_steps = train_cfg.loss.anneal_steps
+    init_lr = opt.init_lr
+    anneal_lr = opt.anneal_lr
+    milestones = jnp.asarray(opt.anneal_steps, jnp.float32)
+    anneal_rate = opt.anneal_rate
+
+    def schedule(step):
+        current = step.astype(jnp.float32) + 1.0
+        ramp = init_lr + (current / ramp_steps) * (anneal_lr - init_lr)
+        n_passed = jnp.sum(current > milestones)
+        decayed = anneal_lr * jnp.power(anneal_rate, n_passed)
+        return jnp.where(current > ramp_steps, decayed, ramp)
+
+    return schedule
+
+
+def make_optimizer(train_cfg: TrainConfig) -> optax.GradientTransformation:
+    opt = train_cfg.optimizer
+    tx = optax.chain(
+        optax.clip_by_global_norm(opt.grad_clip_thresh),
+        # torch.optim.Adam folds weight decay into the gradient BEFORE the
+        # moment estimates (L2, not AdamW) — order matters for parity.
+        optax.add_decayed_weights(opt.weight_decay) if opt.weight_decay else optax.identity(),
+        optax.scale_by_adam(b1=opt.betas[0], b2=opt.betas[1], eps=opt.eps),
+        optax.scale_by_learning_rate(make_lr_schedule(train_cfg)),
+    )
+    if opt.grad_acc_step > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=opt.grad_acc_step)
+    return tx

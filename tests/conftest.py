@@ -1,0 +1,18 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+Must run before any JAX backend initialization. The JAX analogue of a fake
+multi-device backend (the reference has no such thing — SURVEY.md §4): all
+sharding/collective tests run on 8 virtual CPU devices.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+# Override any ambient accelerator plugin (e.g. a tunneled TPU registered by
+# sitecustomize) — unit tests are CPU-only by design.
+jax.config.update("jax_platforms", "cpu")

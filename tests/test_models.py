@@ -1,0 +1,199 @@
+"""Model tests: shapes, jit-traceability, FiLM topology, masking invariance."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from speakingstyle_tpu.configs.config import (
+    Config,
+    ModelConfig,
+    ReferenceEncoderConfig,
+    TransformerConfig,
+    VariancePredictorConfig,
+)
+from speakingstyle_tpu.models.fastspeech2 import FastSpeech2
+from speakingstyle_tpu.models.loss import fastspeech2_loss, film_gate_l2
+
+
+def tiny_config(**model_overrides):
+    tf = TransformerConfig(
+        encoder_layer=2, decoder_layer=2, encoder_hidden=16, decoder_hidden=16,
+        encoder_head=2, decoder_head=2, conv_filter_size=32,
+    )
+    ref = ReferenceEncoderConfig(
+        encoder_layer=1, encoder_head=2, encoder_hidden=16,
+        conv_layer=1, conv_filter_size=32,
+    )
+    vp = VariancePredictorConfig(filter_size=16)
+    mc = ModelConfig(
+        transformer=tf, reference_encoder=ref, variance_predictor=vp,
+        max_seq_len=64, compute_dtype="float32", **model_overrides,
+    )
+    return Config(model=mc)
+
+
+def make_batch(B=2, L=6, T=18, n_mels=80, seed=0):
+    rng = np.random.RandomState(seed)
+    texts = jnp.asarray(rng.randint(1, 300, (B, L)))
+    src_lens = jnp.asarray([L, L - 2])
+    d = np.full((B, L), 3)
+    d[1, L - 2:] = 0
+    d = jnp.asarray(d)
+    mel_lens = d.sum(1)
+    mels = jnp.asarray(rng.randn(B, T, n_mels).astype(np.float32))
+    p = jnp.asarray(rng.randn(B, L).astype(np.float32))
+    e = jnp.asarray(rng.randn(B, L).astype(np.float32))
+    return texts, src_lens, mels, mel_lens, p, e, d
+
+
+@pytest.fixture(scope="module")
+def model_and_vars():
+    cfg = tiny_config()
+    model = FastSpeech2(config=cfg, pitch_stats=(-2, 8), energy_stats=(-1, 9))
+    texts, src_lens, mels, mel_lens, p, e, d = make_batch()
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(
+        {"params": rng, "dropout": rng},
+        jnp.zeros((2,), jnp.int32), texts, src_lens,
+        mels=mels, mel_lens=mel_lens, max_mel_len=18,
+        p_targets=p, e_targets=e, d_targets=d,
+    )
+    return model, variables
+
+
+def test_teacher_forced_shapes(model_and_vars):
+    model, variables = model_and_vars
+    texts, src_lens, mels, mel_lens, p, e, d = make_batch()
+    out = model.apply(
+        variables, jnp.zeros((2,), jnp.int32), texts, src_lens,
+        mels=mels, mel_lens=mel_lens, max_mel_len=18,
+        p_targets=p, e_targets=e, d_targets=d,
+    )
+    assert out["mel"].shape == (2, 18, 80)
+    assert out["mel_postnet"].shape == (2, 18, 80)
+    assert out["log_duration_prediction"].shape == (2, 6)
+    assert out["mel_lens"].tolist() == [18, 12]
+
+
+def test_free_running_uses_predicted_durations(model_and_vars):
+    model, variables = model_and_vars
+    texts, src_lens, mels, mel_lens, *_ = make_batch()
+    out = model.apply(
+        variables, jnp.zeros((2,), jnp.int32), texts, src_lens,
+        mels=mels, mel_lens=mel_lens, max_mel_len=30,
+    )
+    assert out["mel_postnet"].shape == (2, 30, 80)
+    assert out["durations"].dtype == jnp.int32
+
+
+def test_film_gate_count(model_and_vars):
+    # FiLM sites: encoder blocks + decoder blocks + duration predictor ONLY
+    # (reference: model/modules.py:121-131 — pitch/energy unconditioned)
+    _, variables = model_and_vars
+    n_sites = 2 + 2 + 1
+    assert float(film_gate_l2(variables["params"])) == pytest.approx(2 * n_sites)
+
+
+def test_padding_invariance(model_and_vars):
+    """Content beyond src_len must not affect real outputs."""
+    model, variables = model_and_vars
+    texts, src_lens, mels, mel_lens, p, e, d = make_batch()
+    texts2 = texts.at[1, 4:].set(7)  # item 1 has src_len 4; perturb its padding
+    out1 = model.apply(
+        variables, jnp.zeros((2,), jnp.int32), texts, src_lens,
+        mels=mels, mel_lens=mel_lens, max_mel_len=18,
+        p_targets=p, e_targets=e, d_targets=d,
+    )
+    out2 = model.apply(
+        variables, jnp.zeros((2,), jnp.int32), texts2, src_lens,
+        mels=mels, mel_lens=mel_lens, max_mel_len=18,
+        p_targets=p, e_targets=e, d_targets=d,
+    )
+    np.testing.assert_allclose(
+        np.asarray(out1["mel"][1, :12]), np.asarray(out2["mel"][1, :12]),
+        rtol=0, atol=1e-5,
+    )
+
+
+def test_jit_and_grad(model_and_vars):
+    model, variables = model_and_vars
+    texts, src_lens, mels, mel_lens, p, e, d = make_batch()
+
+    @jax.jit
+    def loss_fn(params):
+        out = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            jnp.zeros((2,), jnp.int32), texts, src_lens,
+            mels=mels, mel_lens=mel_lens, max_mel_len=18,
+            p_targets=p, e_targets=e, d_targets=d,
+        )
+        return fastspeech2_loss(out, mels, p, e, d, params, lambda_f=0.001)["total_loss"]
+
+    g = jax.grad(loss_fn)(variables["params"])
+    norms = [float(jnp.abs(x).sum()) for x in jax.tree_util.tree_leaves(g)]
+    assert all(np.isfinite(n) for n in norms)
+    assert sum(n > 0 for n in norms) > len(norms) * 0.5
+
+
+def test_multi_speaker_embedding():
+    cfg = tiny_config(multi_speaker=True)
+    model = FastSpeech2(config=cfg, pitch_stats=(-2, 8), energy_stats=(-1, 9), n_speakers=4)
+    texts, src_lens, mels, mel_lens, p, e, d = make_batch()
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(
+        {"params": rng, "dropout": rng},
+        jnp.asarray([0, 3]), texts, src_lens,
+        mels=mels, mel_lens=mel_lens, max_mel_len=18,
+        p_targets=p, e_targets=e, d_targets=d,
+    )
+    assert "speaker_emb" in variables["params"]
+    out_a = model.apply(
+        variables, jnp.asarray([0, 3]), texts, src_lens,
+        mels=mels, mel_lens=mel_lens, max_mel_len=18,
+        p_targets=p, e_targets=e, d_targets=d,
+    )
+    out_b = model.apply(
+        variables, jnp.asarray([1, 3]), texts, src_lens,
+        mels=mels, mel_lens=mel_lens, max_mel_len=18,
+        p_targets=p, e_targets=e, d_targets=d,
+    )
+    assert not np.allclose(out_a["mel"][0], out_b["mel"][0])
+    np.testing.assert_allclose(out_a["mel"][1], out_b["mel"][1], atol=1e-6)
+
+
+def test_remat_stack_runs():
+    # regression: nn.remat static_argnums must point at `deterministic`
+    import dataclasses
+    from speakingstyle_tpu.configs.config import ShardingConfig, TrainConfig
+
+    cfg = tiny_config()
+    cfg = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, sharding=ShardingConfig(remat=True))
+    )
+    model = FastSpeech2(config=cfg, pitch_stats=(-2, 8), energy_stats=(-1, 9))
+    texts, src_lens, mels, mel_lens, p, e, d = make_batch()
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(
+        {"params": rng, "dropout": rng},
+        jnp.zeros((2,), jnp.int32), texts, src_lens,
+        mels=mels, mel_lens=mel_lens, max_mel_len=18,
+        p_targets=p, e_targets=e, d_targets=d, deterministic=False,
+    )
+    assert variables["params"]
+
+
+def test_loss_ignores_padded_frames(model_and_vars):
+    model, variables = model_and_vars
+    texts, src_lens, mels, mel_lens, p, e, d = make_batch()
+    out = model.apply(
+        variables, jnp.zeros((2,), jnp.int32), texts, src_lens,
+        mels=mels, mel_lens=mel_lens, max_mel_len=18,
+        p_targets=p, e_targets=e, d_targets=d,
+    )
+    l1 = fastspeech2_loss(out, mels, p, e, d, variables["params"])
+    mels_perturbed = mels.at[1, 12:].add(100.0)  # item 1 true mel_len is 12
+    l2 = fastspeech2_loss(out, mels_perturbed, p, e, d, variables["params"])
+    assert float(l1["mel_loss"]) == pytest.approx(float(l2["mel_loss"]))

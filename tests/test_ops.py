@@ -1,0 +1,85 @@
+"""Unit tests for core ops: masking, PE, length regulation, bucketize."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from speakingstyle_tpu.ops.length_regulator import length_regulate, predicted_durations
+from speakingstyle_tpu.ops.masking import length_to_mask, masked_mean
+from speakingstyle_tpu.ops.positional import sinusoid_position_table
+from speakingstyle_tpu.ops.quantize import bucketize, make_bins
+
+
+def test_length_to_mask():
+    m = length_to_mask(jnp.array([3, 1]), 4)
+    assert m.tolist() == [[False, False, False, True], [False, True, True, True]]
+
+
+def test_masked_mean_matches_select_mean():
+    v = jnp.array([1.0, 2.0, 3.0, 100.0])
+    keep = jnp.array([True, True, True, False])
+    assert float(masked_mean(v, keep)) == pytest.approx(2.0)
+
+
+def test_sinusoid_table_reference_formula():
+    # reference: transformer/Models.py:10-30
+    t = sinusoid_position_table(8, 6)
+    pos, j = 3, 4
+    expected_sin = np.sin(pos / np.power(10000, 2 * (j // 2) / 6))
+    assert t[pos, j] == pytest.approx(expected_sin, abs=1e-6)
+    expected_cos = np.cos(pos / np.power(10000, 2 * (5 // 2) / 6))
+    assert t[pos, 5] == pytest.approx(expected_cos, abs=1e-6)
+    assert np.all(t[0, 0::2] == 0.0) and np.all(t[0, 1::2] == 1.0)
+
+
+def test_length_regulate_expands_per_duration():
+    # phoneme i repeated durations[i] times, like the reference Python loop
+    # (reference: model/modules.py:174-197)
+    x = jnp.arange(1, 4, dtype=jnp.float32)[None, :, None]  # [1,3,1] values 1,2,3
+    d = jnp.array([[2, 0, 3]])
+    frames, mel_lens, pad = length_regulate(x, d, 7)
+    assert mel_lens.tolist() == [5]
+    assert frames[0, :, 0].tolist() == [1, 1, 3, 3, 3, 0, 0]
+    assert pad[0].tolist() == [False] * 5 + [True] * 2
+
+
+def test_length_regulate_truncates_to_budget():
+    x = jnp.ones((1, 2, 4))
+    d = jnp.array([[5, 5]])
+    frames, mel_lens, pad = length_regulate(x, d, 6)
+    assert mel_lens.tolist() == [6]
+    assert not bool(pad.any())
+
+
+def test_length_regulate_jits():
+    f = jax.jit(length_regulate, static_argnums=2)
+    x = jnp.ones((2, 3, 4))
+    d = jnp.array([[1, 2, 3], [0, 0, 1]])
+    frames, mel_lens, pad = f(x, d, 8)
+    assert frames.shape == (2, 8, 4)
+    assert mel_lens.tolist() == [6, 1]
+
+
+def test_predicted_durations_round_then_scale():
+    # round(exp(logd)-1) * control, clamped at 0 (reference: modules.py:137-144)
+    logd = jnp.log(jnp.array([[4.0, 1.0, 0.1]]))  # exp-1 = 3, 0, -0.9
+    mask = jnp.array([[False, False, False]])
+    assert predicted_durations(logd, mask, 1.0).tolist() == [[3, 0, 0]]
+    assert predicted_durations(logd, mask, 2.0).tolist() == [[6, 0, 0]]
+    mask2 = jnp.array([[False, False, True]])
+    assert predicted_durations(logd, mask2, 1.0)[0, 2] == 0
+
+
+def test_bucketize_matches_torch_semantics():
+    # torch.bucketize(v, [0,1,2]) == [0,0,1,1,2,3] for v=[-1,0,.5,1,2,3]
+    bins = np.array([0.0, 1.0, 2.0], np.float32)
+    v = jnp.array([-1.0, 0.0, 0.5, 1.0, 2.0, 3.0])
+    assert bucketize(v, bins).tolist() == [0, 0, 1, 1, 2, 3]
+
+
+def test_make_bins():
+    lin = make_bins(0.0, 10.0, 6, "linear")
+    assert lin.shape == (5,) and lin[0] == 0.0 and lin[-1] == 10.0
+    log = make_bins(1.0, 100.0, 5, "log")
+    assert log[0] == pytest.approx(1.0) and log[-1] == pytest.approx(100.0)

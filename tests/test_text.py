@@ -1,0 +1,76 @@
+"""Text frontend unit tests (vocabulary parity + cleaner behavior)."""
+
+from speakingstyle_tpu.text import (
+    PAD_ID,
+    SYMBOL_TO_ID,
+    VOCAB_SIZE,
+    sequence_to_text,
+    symbols,
+    text_to_sequence,
+)
+from speakingstyle_tpu.text.cleaners import english_cleaners
+from speakingstyle_tpu.text.numbers import (
+    normalize_numbers,
+    number_to_words,
+    ordinal_to_words,
+)
+
+
+def test_symbol_inventory_layout():
+    # 360 symbols, vocab 361 (reference: text/symbols.py:21-29, Models.py:40)
+    assert len(symbols) == 360
+    assert VOCAB_SIZE == 361
+    assert symbols[0] == "_" and PAD_ID == 0
+    assert symbols[1] == "-"
+    assert symbols[-3:] == ["@sp", "@spn", "@sil"]
+    # spot-check ARPAbet block starts right after letters
+    assert symbols[12:64] == list("ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz")
+    assert symbols[64] == "@AA"
+    assert len(set(symbols)) == 360  # no duplicates
+
+
+def test_braced_phones_bypass_cleaners():
+    seq = text_to_sequence("{HH AH0 L OW1}", ["english_cleaners"])
+    assert seq == [SYMBOL_TO_ID[s] for s in ["@HH", "@AH0", "@L", "@OW1"]]
+
+
+def test_mixed_text_roundtrip():
+    seq = text_to_sequence("hi {S P IY1 CH} there", ["english_cleaners"])
+    assert sequence_to_text(seq) == "hi {S P IY1 CH} there"
+
+
+def test_pad_never_emitted():
+    assert SYMBOL_TO_ID["_"] not in text_to_sequence("a_b", ["basic_cleaners"])
+
+
+def test_english_cleaners():
+    assert english_cleaners("Dr. Smith") == "doctor smith"
+    assert english_cleaners("Mr.  Jones\n lives") == "mister jones lives"
+    assert english_cleaners("HELLO") == "hello"
+
+
+def test_number_normalization():
+    # 1000 < n < 3000 reads as digit pairs (the reference's year heuristic,
+    # reference: text/numbers.py:50-62)
+    assert normalize_numbers("1,234") == "twelve thirty-four"
+    # inflect-style group commas (reference relies on inflect's rendering)
+    assert normalize_numbers("3,456") == "three thousand, four hundred fifty-six"
+    assert normalize_numbers("$1.50") == "one dollar, fifty cents"
+    assert normalize_numbers("$2") == "two dollars"
+    assert normalize_numbers("2nd") == "second"
+    assert normalize_numbers("21st") == "twenty-first"
+    assert normalize_numbers("3.14") == "three point fourteen"
+    assert normalize_numbers("1999") == "nineteen ninety-nine"
+    assert normalize_numbers("2000") == "two thousand"
+    assert normalize_numbers("2005") == "two thousand five"
+    assert normalize_numbers("1906") == "nineteen oh six"
+    assert normalize_numbers("£5") == "five pounds"
+
+
+def test_number_words():
+    assert number_to_words(0) == "zero"
+    assert number_to_words(115) == "one hundred fifteen"
+    assert number_to_words(1000000) == "one million"
+    assert ordinal_to_words(12) == "twelfth"
+    assert ordinal_to_words(30) == "thirtieth"
+    assert ordinal_to_words(101) == "one hundred and first"
