@@ -1,0 +1,24 @@
+"""Audio DSP: JAX STFT/mel extraction and inversion (TacotronSTFT-equivalent)."""
+
+from speakingstyle_tpu.audio.mel import mel_filterbank
+from speakingstyle_tpu.audio.stft import (
+    MelExtractor,
+    dynamic_range_compression,
+    dynamic_range_decompression,
+    get_mel_from_wav,
+    stft_magnitude,
+)
+from speakingstyle_tpu.audio.tools import griffin_lim, istft, load_wav, save_wav
+
+__all__ = [
+    "MelExtractor",
+    "mel_filterbank",
+    "stft_magnitude",
+    "dynamic_range_compression",
+    "dynamic_range_decompression",
+    "get_mel_from_wav",
+    "griffin_lim",
+    "istft",
+    "load_wav",
+    "save_wav",
+]

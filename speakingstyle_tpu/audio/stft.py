@@ -1,0 +1,112 @@
+"""STFT / mel-spectrogram extraction as pure JAX functions.
+
+Behavioral contract from the reference's conv1d-based STFT
+(reference: audio/stft.py:14-178):
+
+  * reflect-pad the signal by n_fft//2 on both sides,
+  * hann window of ``win_length`` (periodic), zero-center-padded to n_fft,
+  * magnitude = |rfft| per frame (frame count = T//hop + 1),
+  * mel = log(clamp(mel_fb @ mag, 1e-5))   (dynamic-range compression, C=1),
+  * energy = L2 norm of each magnitude frame (audio/stft.py:176).
+
+Implemented as a strided gather + batched rfft instead of a conv against a
+Fourier basis: on TPU the rfft lowers to XLA's native FFT and the windowing
+fuses, so there is no materialized [n_fft, n_fft] basis matmul.
+"""
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from speakingstyle_tpu.audio.mel import mel_filterbank
+
+
+def hann_window(win_length: int, n_fft: int) -> np.ndarray:
+    """Periodic hann of win_length, zero-center-padded to n_fft."""
+    n = np.arange(win_length)
+    w = 0.5 - 0.5 * np.cos(2.0 * np.pi * n / win_length)
+    pad = (n_fft - win_length) // 2
+    out = np.zeros(n_fft, dtype=np.float32)
+    out[pad : pad + win_length] = w
+    return out
+
+
+def frame_signal(y: jnp.ndarray, n_fft: int, hop_length: int) -> jnp.ndarray:
+    """[B, T] -> [B, n_frames, n_fft] reflect-padded overlapping frames."""
+    pad = n_fft // 2
+    y = jnp.pad(y, ((0, 0), (pad, pad)), mode="reflect")
+    n_frames = (y.shape[1] - n_fft) // hop_length + 1
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+    return y[:, idx]
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def stft_magnitude(y, n_fft: int, hop_length: int, win_length: int):
+    """[B, T] float in [-1, 1] -> magnitude [B, 1 + n_fft//2, n_frames]."""
+    frames = frame_signal(y, n_fft, hop_length)
+    window = jnp.asarray(hann_window(win_length, n_fft))
+    spec = jnp.fft.rfft(frames * window, axis=-1)
+    return jnp.abs(spec).astype(jnp.float32).transpose(0, 2, 1)
+
+
+def dynamic_range_compression(x, C: float = 1.0, clip_val: float = 1e-5):
+    return jnp.log(jnp.clip(x, clip_val, None) * C)
+
+
+def dynamic_range_decompression(x, C: float = 1.0):
+    return jnp.exp(x) / C
+
+
+class MelExtractor:
+    """TacotronSTFT equivalent: wav -> (log-mel, energy).
+
+    Pure-function core (``__call__`` jits); the filterbank and window are
+    baked as constants at construction.
+    """
+
+    def __init__(
+        self,
+        filter_length: int = 1024,
+        hop_length: int = 256,
+        win_length: int = 1024,
+        n_mel_channels: int = 80,
+        sampling_rate: int = 22050,
+        mel_fmin: float = 0.0,
+        mel_fmax: Optional[float] = 8000.0,
+    ):
+        self.filter_length = filter_length
+        self.hop_length = hop_length
+        self.win_length = win_length
+        self.n_mel_channels = n_mel_channels
+        self.sampling_rate = sampling_rate
+        self.mel_basis = mel_filterbank(
+            sampling_rate, filter_length, n_mel_channels, mel_fmin, mel_fmax
+        )
+
+        @jax.jit
+        def _extract(y):
+            mag = stft_magnitude(y, filter_length, hop_length, win_length)
+            mel = jnp.einsum("mf,bft->bmt", jnp.asarray(self.mel_basis), mag)
+            mel = dynamic_range_compression(mel)
+            energy = jnp.linalg.norm(mag, axis=1)
+            return mel, energy
+
+        self._extract = _extract
+
+    def mel_spectrogram(self, y: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """[B, T] wav in [-1, 1] -> (mel [B, n_mels, n_frames], energy [B, n_frames])."""
+        return self._extract(y)
+
+    def __call__(self, y):
+        return self.mel_spectrogram(y)
+
+
+def get_mel_from_wav(audio: np.ndarray, extractor: MelExtractor):
+    """Single-utterance numpy convenience (reference: audio/tools.py:8-15)."""
+    audio = np.clip(np.asarray(audio, np.float32), -1.0, 1.0)
+    mel, energy = extractor.mel_spectrogram(jnp.asarray(audio)[None])
+    return np.asarray(mel[0]), np.asarray(energy[0])

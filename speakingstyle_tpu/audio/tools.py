@@ -1,0 +1,99 @@
+"""Inverse STFT, Griffin-Lim, and wav file IO.
+
+Replaces the reference's torch ISTFT/Griffin-Lim
+(reference: audio/stft.py:82-139, audio/audio_processing.py:66-82) with a
+jit-compiled overlap-add implementation, and its scipy wavfile usage
+(reference: utils/tools.py:173-178) with local helpers. Resampling uses
+scipy polyphase filtering (librosa is not a dependency of this framework).
+"""
+
+import functools
+from fractions import Fraction
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.io.wavfile
+import scipy.signal
+
+from speakingstyle_tpu.audio.stft import frame_signal, hann_window
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def istft(magnitude, phase, n_fft: int, hop_length: int, win_length: int):
+    """Inverse STFT via windowed overlap-add.
+
+    magnitude/phase: [B, 1 + n_fft//2, n_frames] -> wav [B, T] with the
+    n_fft//2 reflect-pad of the forward transform trimmed off.
+    """
+    spec = magnitude * jnp.exp(1j * phase)
+    frames = jnp.fft.irfft(spec.transpose(0, 2, 1), n=n_fft, axis=-1)
+    window = jnp.asarray(hann_window(win_length, n_fft))
+    frames = frames * window
+
+    B, n_frames, _ = frames.shape
+    out_len = n_fft + hop_length * (n_frames - 1)
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(n_fft)[None, :]  # [n_frames, n_fft]
+
+    flat_idx = idx.reshape(-1)
+    sig = jax.vmap(
+        lambda f: jnp.zeros(out_len).at[flat_idx].add(f.reshape(-1))
+    )(frames)
+    # window sum-square normalization (reference: audio/audio_processing.py:7-63)
+    wss = jnp.zeros(out_len).at[flat_idx].add(jnp.tile(window**2, (n_frames, 1)).reshape(-1))
+    sig = sig / jnp.where(wss > 1e-11, wss, 1.0)
+
+    pad = n_fft // 2
+    return sig[:, pad : out_len - pad]
+
+
+def _stft_phase(y, n_fft, hop_length, win_length):
+    frames = frame_signal(y, n_fft, hop_length)
+    window = jnp.asarray(hann_window(win_length, n_fft))
+    spec = jnp.fft.rfft(frames * window, axis=-1).transpose(0, 2, 1)
+    return jnp.angle(spec)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def griffin_lim(magnitudes, n_fft: int, hop_length: int, win_length: int, n_iters: int = 30):
+    """Phase reconstruction from magnitude spectrogram [B, F, T] -> wav [B, T']."""
+    key = jax.random.PRNGKey(0)
+    angles = jax.random.uniform(key, magnitudes.shape, minval=-np.pi, maxval=np.pi)
+
+    def body(_, angles):
+        signal = istft(magnitudes, angles, n_fft, hop_length, win_length)
+        return _stft_phase(signal, n_fft, hop_length, win_length)[
+            ..., : magnitudes.shape[-1]
+        ]
+
+    angles = jax.lax.fori_loop(0, n_iters, body, angles)
+    return istft(magnitudes, angles, n_fft, hop_length, win_length)
+
+
+def load_wav(path: str, target_sr: int = None) -> tuple:
+    """Read a wav file -> (float32 array in [-1, 1], sample_rate)."""
+    sr, data = scipy.io.wavfile.read(path)
+    if data.dtype == np.int16:
+        data = data.astype(np.float32) / 32768.0
+    elif data.dtype == np.int32:
+        data = data.astype(np.float32) / 2147483648.0
+    elif data.dtype == np.uint8:
+        data = (data.astype(np.float32) - 128.0) / 128.0
+    else:
+        data = data.astype(np.float32)
+    if data.ndim > 1:
+        data = data.mean(axis=1)
+    if target_sr is not None and sr != target_sr:
+        frac = Fraction(target_sr, sr).limit_denominator(1000)
+        data = scipy.signal.resample_poly(data, frac.numerator, frac.denominator)
+        sr = target_sr
+    return data.astype(np.float32), sr
+
+
+def save_wav(path: str, wav: np.ndarray, sampling_rate: int, max_wav_value: float = 32768.0):
+    wav = np.asarray(wav, np.float32)
+    peak = max(np.abs(wav).max(), 1e-8)
+    if peak > 1.0:
+        wav = wav / peak
+    scipy.io.wavfile.write(path, sampling_rate, (wav * (max_wav_value - 1)).astype(np.int16))
