@@ -1,0 +1,21 @@
+"""Data pipeline: preprocessed-feature datasets, bucketed batching, prefetch."""
+
+from speakingstyle_tpu.data.dataset import (
+    Batch,
+    BucketedBatcher,
+    SpeechDataset,
+    TextBatcher,
+    bucket_length,
+    parse_metadata,
+)
+from speakingstyle_tpu.data.prefetch import DevicePrefetcher
+
+__all__ = [
+    "Batch",
+    "BucketedBatcher",
+    "SpeechDataset",
+    "TextBatcher",
+    "bucket_length",
+    "parse_metadata",
+    "DevicePrefetcher",
+]

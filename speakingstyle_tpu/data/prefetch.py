@@ -1,0 +1,66 @@
+"""Background-thread host→device prefetch.
+
+Replaces the reference's 40-worker torch DataLoader (reference:
+train.py:33-41): feature loading + collate run on a worker thread pool while
+the device computes, and finished batches are device_put with the mesh's
+batch sharding ahead of time so each step starts with data already in HBM.
+"""
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+import jax
+
+from speakingstyle_tpu.data.dataset import Batch
+from speakingstyle_tpu.parallel.mesh import batch_sharding
+
+
+class DevicePrefetcher:
+    """Wrap a host batch iterator; yield (Batch, device_arrays) pairs."""
+
+    def __init__(self, batches: Iterator[Batch], mesh=None, depth: int = 2):
+        self.batches = batches
+        self.sharding = batch_sharding(mesh) if mesh is not None else None
+        self.queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self.thread = threading.Thread(target=self._worker, daemon=True)
+        self._stopped = threading.Event()
+        self.thread.start()
+
+    def _put(self, batch: Batch):
+        arrays = batch.arrays()
+        if self.sharding is not None:
+            arrays = {
+                k: jax.device_put(v, self.sharding) for k, v in arrays.items()
+            }
+        return batch, arrays
+
+    def _worker(self):
+        try:
+            for batch in self.batches:
+                if self._stopped.is_set():
+                    return
+                self.queue.put(self._put(batch))
+        except Exception as e:  # surface loader errors on the consumer side
+            self.queue.put(e)
+        self.queue.put(None)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self.queue.get()
+        if item is None:
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def stop(self):
+        self._stopped.set()
+        # drain so the worker unblocks
+        try:
+            while True:
+                self.queue.get_nowait()
+        except queue.Empty:
+            pass

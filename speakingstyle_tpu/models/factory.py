@@ -1,0 +1,71 @@
+"""Model factory: build FastSpeech2 from config + preprocessed-dataset stats.
+
+Reference: utils/model.py:11-45 (get_model). Pitch/energy bin ranges come
+from stats.json and the speaker count from speakers.json, both written by
+the preprocessor.
+"""
+
+import json
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.models.fastspeech2 import FastSpeech2
+
+
+def load_dataset_stats(cfg: Config) -> Tuple[tuple, tuple, int]:
+    """(pitch_min_max, energy_min_max, n_speakers) from the preprocessed dir."""
+    root = cfg.preprocess.path.preprocessed_path
+    pitch_stats, energy_stats, n_speakers = (-3.0, 12.0), (-2.0, 10.0), 1
+    stats_path = os.path.join(root, "stats.json") if root else ""
+    if stats_path and os.path.exists(stats_path):
+        with open(stats_path) as f:
+            stats = json.load(f)
+        pitch_stats = tuple(stats["pitch"][:2])
+        energy_stats = tuple(stats["energy"][:2])
+    speakers_path = os.path.join(root, "speakers.json") if root else ""
+    if speakers_path and os.path.exists(speakers_path):
+        with open(speakers_path) as f:
+            n_speakers = max(len(json.load(f)), 1)
+    return pitch_stats, energy_stats, n_speakers
+
+
+def build_model(cfg: Config, n_position: Optional[int] = None) -> FastSpeech2:
+    pitch_stats, energy_stats, n_speakers = load_dataset_stats(cfg)
+    return FastSpeech2(
+        config=cfg,
+        pitch_stats=pitch_stats,
+        energy_stats=energy_stats,
+        n_speakers=n_speakers,
+        n_position=n_position,
+    )
+
+
+def init_variables(model: FastSpeech2, cfg: Config, rng: jax.Array):
+    """Initialize params/batch_stats with a minimal teacher-forced dummy batch."""
+    n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+    B, L, T = 2, 8, 16
+    dummy = dict(
+        speakers=jnp.zeros((B,), jnp.int32),
+        texts=jnp.ones((B, L), jnp.int32),
+        src_lens=jnp.full((B,), L, jnp.int32),
+        mels=jnp.zeros((B, T, n_mels), jnp.float32),
+        mel_lens=jnp.full((B,), T, jnp.int32),
+        max_mel_len=T,
+        p_targets=jnp.zeros((B, L), jnp.float32),
+        e_targets=jnp.zeros((B, L), jnp.float32),
+        d_targets=jnp.full((B, L), T // L, jnp.int32),
+    )
+    rngs = {"params": rng, "dropout": rng}
+    return model.init(rngs, deterministic=True, **dummy)
+
+
+def count_params(params) -> int:
+    """Total parameter count (reference: utils/model.py:48-51)."""
+    return int(
+        sum(np.prod(p.shape) for p in jax.tree_util.tree_leaves(params))
+    )

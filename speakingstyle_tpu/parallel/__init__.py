@@ -1,0 +1,22 @@
+"""Parallelism: device meshes, shardings, and sequence-parallel attention."""
+
+from speakingstyle_tpu.parallel.mesh import (
+    batch_sharding,
+    local_batch_size,
+    make_mesh,
+    make_seq_mesh,
+    replicated,
+    shard_batch,
+)
+from speakingstyle_tpu.parallel.ring_attention import ring_attention, ring_self_attention
+
+__all__ = [
+    "make_mesh",
+    "make_seq_mesh",
+    "batch_sharding",
+    "replicated",
+    "shard_batch",
+    "local_batch_size",
+    "ring_attention",
+    "ring_self_attention",
+]

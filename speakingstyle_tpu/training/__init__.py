@@ -1,0 +1,26 @@
+"""Training: optax optimizer chain, sharded step functions, loop, checkpointing."""
+
+from speakingstyle_tpu.training.checkpoint import CheckpointManager
+from speakingstyle_tpu.training.optim import make_lr_schedule, make_optimizer
+from speakingstyle_tpu.training.state import TrainState
+from speakingstyle_tpu.training.trainer import (
+    TrainLogger,
+    evaluate,
+    make_eval_step,
+    make_predict_step,
+    make_train_step,
+    run_training,
+)
+
+__all__ = [
+    "CheckpointManager",
+    "make_lr_schedule",
+    "make_optimizer",
+    "TrainState",
+    "TrainLogger",
+    "evaluate",
+    "make_eval_step",
+    "make_predict_step",
+    "make_train_step",
+    "run_training",
+]

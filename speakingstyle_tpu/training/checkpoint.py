@@ -1,0 +1,70 @@
+"""Orbax checkpointing: step-named save/restore with partial loading.
+
+Replaces the reference's ``torch.save({"model", "optimizer"})`` every
+save_step (reference: train.py:155-165) and its ``ignore_layers`` +
+``strict=False`` transfer-learning restore (reference: utils/model.py:15-32,
+config/BC2013/train.yaml:1).
+"""
+
+import os
+import re
+from typing import Optional, Sequence
+
+import jax
+import orbax.checkpoint as ocp
+
+from speakingstyle_tpu.training.state import TrainState
+
+
+class CheckpointManager:
+    def __init__(self, directory: str, max_to_keep: Optional[int] = None):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True, enable_async_checkpointing=False
+            ),
+        )
+
+    def save(self, step: int, state: TrainState):
+        self.manager.save(step, args=ocp.args.StandardSave(state))
+        self.manager.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def restore(
+        self,
+        state: TrainState,
+        step: Optional[int] = None,
+        ignore_layers: Sequence[str] = (),
+    ) -> TrainState:
+        """Restore into the shape of `state` (the abstract template).
+
+        ignore_layers: regexes matched against '/'-joined param paths; matching
+        leaves keep their freshly-initialized values AND the optimizer state is
+        reset (the reference reinitializes the optimizer when transferring).
+        """
+        step = step if step is not None else self.manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint found under {self.directory}")
+        abstract = jax.tree_util.tree_map(
+            ocp.utils.to_shape_dtype_struct, state
+        )
+        restored = self.manager.restore(step, args=ocp.args.StandardRestore(abstract))
+        if ignore_layers:
+            patterns = [re.compile(p) for p in ignore_layers]
+
+            def merge(path, fresh, loaded):
+                name = "/".join(str(getattr(k, "key", k)) for k in path)
+                return fresh if any(p.search(name) for p in patterns) else loaded
+
+            params = jax.tree_util.tree_map_with_path(
+                merge, state.params, restored.params
+            )
+            return state.replace(params=params, batch_stats=restored.batch_stats)
+        return restored
+
+    def close(self):
+        self.manager.close()

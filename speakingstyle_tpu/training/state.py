@@ -1,0 +1,31 @@
+"""Train state: the replicated pytree carried across steps.
+
+Unlike the reference's (module, optimizer) object pair
+(reference: utils/model.py:11-45), state is one pure pytree — params,
+PostNet batch_stats, optax state, and the step counter — so it jits,
+shards, donates, and checkpoints as a unit.
+"""
+
+from typing import Any
+
+import flax.struct
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray            # [] int32
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+    @classmethod
+    def create(cls, variables, tx: optax.GradientTransformation):
+        params = variables["params"]
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=variables.get("batch_stats", {}),
+            opt_state=tx.init(params),
+        )

@@ -1,0 +1,309 @@
+"""Training orchestration: sharded jit steps + the step loop.
+
+The reference's loop (reference: train.py:79-173) maps here as:
+  nn.DataParallel scatter/gather  ->  batch sharded over the mesh's data
+                                      axis; XLA inserts the gradient psum
+  backward + clip + custom LR     ->  optax chain (training/optim.py)
+  periodic log/val/save           ->  callbacks driven by the step counter
+
+The train step is compiled once per batch-bucket shape (data/dataset.py
+bucket grid); state is replicated, donated, and updated in place.
+"""
+
+import os
+from typing import Dict, Iterator, Optional
+
+import jax
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.models.loss import fastspeech2_loss
+from speakingstyle_tpu.training.state import TrainState
+
+
+def _model_kwargs(arrays: Dict, teacher_forced: bool) -> Dict:
+    kw = dict(
+        speakers=arrays["speakers"],
+        texts=arrays["texts"],
+        src_lens=arrays["src_lens"],
+        mels=arrays["mels"],
+        mel_lens=arrays["mel_lens"],
+        max_mel_len=arrays["mels"].shape[1],
+    )
+    if teacher_forced:
+        kw.update(
+            p_targets=arrays["pitches"],
+            e_targets=arrays["energies"],
+            d_targets=arrays["durations"],
+        )
+    return kw
+
+
+def make_train_step(model, tx, cfg: Config, mesh=None):
+    """Returns jitted fn(state, arrays, rng) -> (state, losses)."""
+    lambda_f = cfg.train.loss.lambda_f
+    p_level = cfg.preprocess.preprocessing.pitch.feature
+    e_level = cfg.preprocess.preprocessing.energy.feature
+
+    def step_fn(state: TrainState, arrays: Dict, rng) -> tuple:
+        rng = jax.random.fold_in(rng, state.step)
+
+        def loss_fn(params):
+            out, updates = model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                **_model_kwargs(arrays, teacher_forced=True),
+                deterministic=False,
+                rngs={"dropout": rng},
+                mutable=["batch_stats"],
+            )
+            losses = fastspeech2_loss(
+                out,
+                arrays["mels"],
+                arrays["pitches"],
+                arrays["energies"],
+                arrays["durations"],
+                params,
+                lambda_f=lambda_f,
+                pitch_feature_level=p_level,
+                energy_feature_level=e_level,
+            )
+            return losses["total_loss"], (losses, updates["batch_stats"])
+
+        (_, (losses, batch_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=opt_state,
+        )
+        return new_state, losses
+
+    if mesh is None:
+        return jax.jit(step_fn, donate_argnums=(0,))
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P("data"))
+    return jax.jit(
+        step_fn,
+        in_shardings=(repl, data, repl),
+        out_shardings=(repl, repl),
+        donate_argnums=(0,),
+    )
+
+
+def make_eval_step(model, cfg: Config, mesh=None):
+    """Teacher-forced loss evaluation (reference: evaluate.py:39-58)."""
+    lambda_f = cfg.train.loss.lambda_f
+    p_level = cfg.preprocess.preprocessing.pitch.feature
+    e_level = cfg.preprocess.preprocessing.energy.feature
+
+    def eval_fn(state: TrainState, arrays: Dict) -> Dict:
+        out = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            **_model_kwargs(arrays, teacher_forced=True),
+            deterministic=True,
+        )
+        return fastspeech2_loss(
+            out,
+            arrays["mels"],
+            arrays["pitches"],
+            arrays["energies"],
+            arrays["durations"],
+            state.params,
+            lambda_f=lambda_f,
+            pitch_feature_level=p_level,
+            energy_feature_level=e_level,
+        )
+
+    if mesh is None:
+        return jax.jit(eval_fn)
+    repl = NamedSharding(mesh, P())
+    data = NamedSharding(mesh, P("data"))
+    return jax.jit(eval_fn, in_shardings=(repl, data), out_shardings=repl)
+
+
+def make_predict_step(model, cfg: Config, mesh=None):
+    """Free-running synthesis step (style mel in, no p/e/d targets)."""
+
+    def predict_fn(
+        state: TrainState,
+        arrays: Dict,
+        max_mel_len: int,
+        p_control: float = 1.0,
+        e_control: float = 1.0,
+        d_control: float = 1.0,
+    ):
+        return model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            speakers=arrays["speakers"],
+            texts=arrays["texts"],
+            src_lens=arrays["src_lens"],
+            mels=arrays["mels"],
+            mel_lens=arrays["mel_lens"],
+            max_mel_len=max_mel_len,
+            p_control=p_control,
+            e_control=e_control,
+            d_control=d_control,
+            deterministic=True,
+        )
+
+    return jax.jit(predict_fn, static_argnums=(2,))
+
+
+def evaluate(eval_step, state, batches: Iterator) -> Dict[str, float]:
+    """Batch-size-weighted mean of every loss over a val pass
+    (reference: evaluate.py:39-58)."""
+    sums: Dict[str, float] = {}
+    count = 0
+    for batch, arrays in batches:
+        losses = eval_step(state, arrays)
+        n = batch.n_real
+        count += n
+        for k, v in losses.items():
+            sums[k] = sums.get(k, 0.0) + float(v) * n
+    if count == 0:
+        return {}
+    return {k: v / count for k, v in sums.items()}
+
+
+def run_training(
+    cfg: Config,
+    mesh=None,
+    restore_step: Optional[int] = None,
+    max_steps: Optional[int] = None,
+    synth_callback=None,
+    log: bool = True,
+):
+    """The full training loop (reference: train.py:21-173).
+
+    Returns the final TrainState. `max_steps` overrides total_step (tests);
+    `synth_callback(state, batch, arrays, step)` runs every synth_step.
+    """
+    import jax.numpy as jnp
+
+    from speakingstyle_tpu.data import (
+        BucketedBatcher,
+        DevicePrefetcher,
+        SpeechDataset,
+    )
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.training.checkpoint import CheckpointManager
+    from speakingstyle_tpu.training.optim import make_lr_schedule, make_optimizer
+
+    steps = cfg.train.step
+    total_step = max_steps if max_steps is not None else steps.total_step
+
+    model = build_model(cfg)
+    rng = jax.random.PRNGKey(cfg.train.seed)
+    variables = init_variables(model, cfg, rng)
+    tx = make_optimizer(cfg.train)
+    state = TrainState.create(variables, tx)
+    schedule = make_lr_schedule(cfg.train)
+
+    ckpt = CheckpointManager(cfg.train.path.ckpt_path)
+    if restore_step is not None:
+        state = ckpt.restore(
+            state,
+            step=restore_step if restore_step > 0 else None,
+            ignore_layers=cfg.train.ignore_layers,
+        )
+
+    if mesh is not None:
+        repl = NamedSharding(mesh, P())
+        state = jax.device_put(state, repl)
+
+    train_step = make_train_step(model, tx, cfg, mesh=mesh)
+    eval_step = make_eval_step(model, cfg, mesh=mesh)
+
+    max_src = max_mel = cfg.model.max_seq_len
+    pad_mult = mesh.shape["data"] if mesh is not None else 1
+    train_ds = SpeechDataset("train.txt", cfg, sort=True, drop_last=True)
+    batcher = BucketedBatcher(
+        train_ds,
+        max_src=max_src,
+        max_mel=max_mel,
+        batch_pad_multiple=pad_mult,
+        seed=cfg.train.seed,
+    )
+    prefetch = DevicePrefetcher(iter(batcher), mesh=mesh)
+    val_ds = SpeechDataset("val.txt", cfg, sort=False, drop_last=False)
+    val_batcher = BucketedBatcher(
+        val_ds,
+        max_src=max_src,
+        max_mel=max_mel,
+        batch_pad_multiple=pad_mult,
+        seed=0,
+    )
+
+    logger = TrainLogger(cfg.train.path.log_path) if log else None
+    step_rng = jax.random.PRNGKey(cfg.train.seed + 1)
+
+    step = int(state.step)
+    try:
+        for batch, arrays in prefetch:
+            if step >= total_step:
+                break
+            state, losses = train_step(state, arrays, step_rng)
+            step += 1
+
+            if logger and step % steps.log_step == 0:
+                lr = float(schedule(jnp.asarray(step - 1)))
+                logger.log(step, {k: float(v) for k, v in losses.items()}, lr=lr)
+            if synth_callback is not None and step % steps.synth_step == 0:
+                synth_callback(state, batch, arrays, step)
+            if step % steps.val_step == 0:
+                val_losses = evaluate(
+                    eval_step,
+                    state,
+                    DevicePrefetcher(val_batcher.epoch(shuffle=False), mesh=mesh),
+                )
+                if logger:
+                    logger.log(step, val_losses, prefix="val")
+            if step % steps.save_step == 0:
+                ckpt.save(step, jax.device_get(state))
+    finally:
+        prefetch.stop()
+        if logger:
+            logger.close()
+        ckpt.close()
+    return state
+
+
+class TrainLogger:
+    """TensorBoard scalars + append-only log.txt (reference: train.py:53-61,
+    utils/tools.py:82-107). tensorboardX is optional; text log always works."""
+
+    def __init__(self, log_dir: str, use_tensorboard: bool = True):
+        os.makedirs(log_dir, exist_ok=True)
+        self.txt = open(os.path.join(log_dir, "log.txt"), "a")
+        self.tb = None
+        if use_tensorboard:
+            try:
+                from tensorboardX import SummaryWriter
+
+                self.tb = SummaryWriter(log_dir)
+            except ImportError:
+                pass
+
+    def log(self, step: int, losses: Dict[str, float], lr: Optional[float] = None, prefix: str = "train"):
+        msg = f"[{prefix}] Step {step}, " + ", ".join(
+            f"{k}: {float(v):.4f}" for k, v in losses.items()
+        )
+        if lr is not None:
+            msg += f", lr: {lr:.6f}"
+        self.txt.write(msg + "\n")
+        self.txt.flush()
+        if self.tb is not None:
+            for k, v in losses.items():
+                self.tb.add_scalar(f"{prefix}/{k}", float(v), step)
+            if lr is not None:
+                self.tb.add_scalar(f"{prefix}/lr", lr, step)
+
+    def close(self):
+        self.txt.close()
+        if self.tb is not None:
+            self.tb.close()

@@ -1,0 +1,111 @@
+"""Data pipeline tests: loading, sort-group collate, bucketing, prefetch."""
+
+import dataclasses
+
+import numpy as np
+
+from speakingstyle_tpu.configs.config import PathConfig, load_config
+from speakingstyle_tpu.data import (
+    BucketedBatcher,
+    DevicePrefetcher,
+    SpeechDataset,
+    TextBatcher,
+    bucket_length,
+)
+
+
+def _config(root, batch_size=4):
+    cfg = load_config(preset="LJSpeech")
+    pp = dataclasses.replace(cfg.preprocess, path=PathConfig(preprocessed_path=root))
+    opt = dataclasses.replace(cfg.train.optimizer, batch_size=batch_size)
+    tr = dataclasses.replace(cfg.train, optimizer=opt)
+    return dataclasses.replace(cfg, preprocess=pp, train=tr)
+
+
+def test_bucket_length():
+    assert bucket_length(1, 32) == 32
+    assert bucket_length(32, 32) == 32
+    assert bucket_length(33, 32) == 64
+    assert bucket_length(999, 128, max_len=1000) == 1000
+
+
+def test_dataset_items(synthetic_preprocessed):
+    ds = SpeechDataset("train.txt", _config(synthetic_preprocessed))
+    assert len(ds) == 10
+    item = ds[0]
+    assert item["mel"].shape[1] == 80
+    assert item["duration"].sum() == item["mel"].shape[0]
+    assert len(item["pitch"]) == len(item["text"]) == len(item["duration"])
+    assert item["text"].dtype == np.int32 and (item["text"] > 0).all()
+
+
+def test_batcher_static_shapes_and_sort(synthetic_preprocessed):
+    cfg = _config(synthetic_preprocessed)
+    ds = SpeechDataset("train.txt", cfg, sort=True, drop_last=False)
+    batcher = BucketedBatcher(ds, src_bucket=32, mel_bucket=128)
+    batches = list(batcher.epoch(shuffle=False))
+    assert sum(len(b.ids) for b in batches) == 10
+    for b in batches:
+        B, L_src = b.texts.shape
+        assert L_src % 32 == 0
+        assert b.mels.shape[1] % 128 == 0
+        assert b.mels.shape[2] == 80
+        # sorted descending within each batch
+        assert (np.diff(b.src_lens) <= 0).all()
+        # durations sum to mel length per item
+        for i in range(B):
+            assert b.durations[i].sum() == b.mel_lens[i]
+            # padding is zero beyond src_len
+            assert (b.texts[i, b.src_lens[i]:] == 0).all()
+
+
+def test_batcher_truncation_keeps_duration_sum(synthetic_preprocessed):
+    cfg = _config(synthetic_preprocessed)
+    ds = SpeechDataset("train.txt", cfg)
+    batcher = BucketedBatcher(ds, src_bucket=16, mel_bucket=32, max_mel=32)
+    for b in batcher.epoch(shuffle=False):
+        assert b.mels.shape[1] <= 32
+        for i in range(len(b.ids)):
+            assert b.durations[i].sum() == b.mel_lens[i] <= 32
+
+
+def test_src_truncation_shrinks_mel_len(synthetic_preprocessed):
+    """When max_src drops phonemes, mel_len must shrink to the frames still
+    covered so sum(duration) == mel_len holds for every item."""
+    cfg = _config(synthetic_preprocessed)
+    ds = SpeechDataset("train.txt", cfg)
+    batcher = BucketedBatcher(ds, src_bucket=4, mel_bucket=16, max_src=4)
+    for b in batcher.epoch(shuffle=False):
+        for i in range(len(b.ids)):
+            assert b.durations[i].sum() == b.mel_lens[i]
+            assert b.src_lens[i] <= 4
+
+
+def test_infinite_iter_reshuffles(synthetic_preprocessed):
+    cfg = _config(synthetic_preprocessed)
+    ds = SpeechDataset("train.txt", cfg)
+    batcher = BucketedBatcher(ds, seed=7)
+    it = iter(batcher)
+    seen = [next(it).ids for _ in range(8)]  # > 1 epoch of 3 batches
+    assert len(seen) == 8  # stream does not exhaust
+
+
+def test_device_prefetcher(synthetic_preprocessed):
+    cfg = _config(synthetic_preprocessed)
+    ds = SpeechDataset("train.txt", cfg)
+    batcher = BucketedBatcher(ds)
+    pf = DevicePrefetcher(batcher.epoch(shuffle=False), mesh=None)
+    batch, arrays = next(pf)
+    assert set(arrays) >= {"texts", "mels", "durations"}
+    assert arrays["mels"].shape[0] == len(batch.ids)
+    pf.stop()
+
+
+def test_text_batcher(synthetic_preprocessed, tmp_path):
+    cfg = _config(synthetic_preprocessed)
+    src = tmp_path / "source.txt"
+    src.write_text("utt000|LJSpeech|{AH0 K T}|hello\n")
+    tb = TextBatcher(str(src), cfg)
+    item = tb[0]
+    assert item["text"].shape == (3,)
+    assert item["mel"] is not None  # found the preprocessed mel for style

@@ -1,0 +1,151 @@
+"""Training-subsystem tests: sharded steps, loop, checkpointing, restore."""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from speakingstyle_tpu.configs.config import (
+    PathConfig,
+    StepConfig,
+    TrainPathConfig,
+    load_config,
+)
+from speakingstyle_tpu.models.factory import build_model, count_params, init_variables
+from speakingstyle_tpu.parallel import make_mesh
+from speakingstyle_tpu.training import (
+    CheckpointManager,
+    TrainState,
+    make_eval_step,
+    make_optimizer,
+    make_train_step,
+    run_training,
+)
+
+
+def tiny_train_config(root, tmp_path, batch_size=8):
+    cfg = load_config(preset="LJSpeech")
+    tf = dataclasses.replace(
+        cfg.model.transformer,
+        encoder_layer=1, decoder_layer=1,
+        encoder_hidden=32, decoder_hidden=32, conv_filter_size=64,
+    )
+    ref = dataclasses.replace(
+        cfg.model.reference_encoder,
+        encoder_layer=1, encoder_hidden=32, conv_filter_size=32, encoder_head=2,
+    )
+    vp = dataclasses.replace(cfg.model.variance_predictor, filter_size=32)
+    mc = dataclasses.replace(
+        cfg.model, transformer=tf, reference_encoder=ref, variance_predictor=vp,
+        max_seq_len=256, compute_dtype="float32",
+    )
+    pp = dataclasses.replace(cfg.preprocess, path=PathConfig(preprocessed_path=root))
+    opt = dataclasses.replace(cfg.train.optimizer, batch_size=batch_size)
+    steps = StepConfig(total_step=4, log_step=2, synth_step=100, val_step=3, save_step=4)
+    paths = TrainPathConfig(
+        ckpt_path=str(tmp_path / "ckpt"),
+        log_path=str(tmp_path / "log"),
+        result_path=str(tmp_path / "result"),
+    )
+    tr = dataclasses.replace(
+        cfg.train, optimizer=opt, step=steps, path=paths
+    )
+    return dataclasses.replace(cfg, preprocess=pp, model=mc, train=tr)
+
+
+def test_count_params_and_init(synthetic_preprocessed, tmp_path):
+    cfg = tiny_train_config(synthetic_preprocessed, tmp_path)
+    model = build_model(cfg)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    n = count_params(variables["params"])
+    assert n > 1000
+    assert "batch_stats" in variables
+
+
+def test_sharded_train_step_runs_and_descends(synthetic_preprocessed, tmp_path):
+    cfg = tiny_train_config(synthetic_preprocessed, tmp_path)
+    mesh = make_mesh()  # 8 virtual devices
+    model = build_model(cfg)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    tx = make_optimizer(cfg.train)
+    state = TrainState.create(variables, tx)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    state = jax.device_put(state, NamedSharding(mesh, P()))
+    train_step = make_train_step(model, tx, cfg, mesh=mesh)
+
+    from speakingstyle_tpu.data import BucketedBatcher, DevicePrefetcher, SpeechDataset
+
+    ds = SpeechDataset("train.txt", cfg, sort=True, drop_last=True)
+    batcher = BucketedBatcher(ds, max_src=256, max_mel=256)
+    pf = DevicePrefetcher(iter(batcher), mesh=mesh)
+    rng = jax.random.PRNGKey(1)
+    losses_hist = []
+    for i, (batch, arrays) in enumerate(pf):
+        if i >= 6:
+            break
+        state, losses = train_step(state, arrays, rng)
+        losses_hist.append(float(losses["total_loss"]))
+    pf.stop()
+    assert int(state.step) == 6
+    assert all(np.isfinite(losses_hist))
+    assert losses_hist[-1] < losses_hist[0]
+
+
+def test_run_training_end_to_end_with_checkpoint(synthetic_preprocessed, tmp_path):
+    cfg = tiny_train_config(synthetic_preprocessed, tmp_path)
+    state = run_training(cfg, mesh=make_mesh(), max_steps=4, log=True)
+    assert int(state.step) == 4
+    # checkpoint written at step 4
+    ckpt = CheckpointManager(cfg.train.path.ckpt_path)
+    assert ckpt.latest_step() == 4
+    # log.txt written
+    log_file = os.path.join(cfg.train.path.log_path, "log.txt")
+    assert os.path.exists(log_file) and "Step" in open(log_file).read()
+
+    # restore round-trips exactly
+    model = build_model(cfg)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(cfg.train.seed))
+    tx = make_optimizer(cfg.train)
+    fresh = TrainState.create(variables, tx)
+    restored = ckpt.restore(fresh)
+    assert int(restored.step) == 4
+    got = jax.tree_util.tree_leaves(restored.params)
+    want = jax.tree_util.tree_leaves(jax.device_get(state).params)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    ckpt.close()
+
+
+def test_restore_ignore_layers(synthetic_preprocessed, tmp_path):
+    cfg = tiny_train_config(synthetic_preprocessed, tmp_path)
+    model = build_model(cfg)
+    tx = make_optimizer(cfg.train)
+    v1 = init_variables(model, cfg, jax.random.PRNGKey(0))
+    state1 = TrainState.create(v1, tx).replace(step=jnp.asarray(7, jnp.int32))
+    ckpt = CheckpointManager(str(tmp_path / "ck2"))
+    ckpt.save(7, state1)
+
+    v2 = init_variables(model, cfg, jax.random.PRNGKey(99))
+    fresh = TrainState.create(v2, tx)
+    restored = ckpt.restore(fresh, ignore_layers=["speaker_emb|mel_linear"])
+    # mel_linear kept fresh
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["mel_linear"]["kernel"]),
+        np.asarray(v2["params"]["mel_linear"]["kernel"]),
+    )
+    # encoder loaded from checkpoint
+    got = jax.tree_util.tree_leaves(restored.params["encoder"])
+    want = jax.tree_util.tree_leaves(v1["params"]["encoder"])
+    assert any(
+        not np.array_equal(a, b)
+        for a, b in zip(
+            jax.tree_util.tree_leaves(v2["params"]["encoder"]), want
+        )
+    )  # sanity: the two inits differ
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+    ckpt.close()
