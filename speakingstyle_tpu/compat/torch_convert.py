@@ -1,0 +1,89 @@
+"""PyTorch checkpoint -> Flax parameter conversion.
+
+Loads reference-framework checkpoints for the parity gate (SURVEY.md §6):
+the HiFi-GAN generator (``generator_*.pth.tar`` with weight-normed convs,
+reference: hifigan/models.py:112-174) and, via `convert_fastspeech2`, the
+acoustic-model checkpoints (reference: train.py:155-165 format —
+``{"model": state_dict, "optimizer": ...}``).
+
+All functions take a plain ``dict[str, np.ndarray]`` state_dict, so torch is
+only needed by the caller that unpickles the file (`load_torch_state_dict`).
+"""
+
+from typing import Dict
+
+import numpy as np
+
+
+def load_torch_state_dict(path: str, key: str = None) -> Dict[str, np.ndarray]:
+    """Unpickle a torch checkpoint to numpy (CPU). torch required here only."""
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    if key is not None:
+        obj = obj[key]
+    return {k: v.detach().cpu().numpy() for k, v in obj.items()}
+
+
+def fold_weight_norm(sd: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Collapse every (weight_g, weight_v) pair into a plain weight.
+
+    torch weight_norm default dim=0: ||v|| is computed over all dims except
+    the first (reference inference calls remove_weight_norm,
+    hifigan/models.py:167-174 — this is its numpy equivalent).
+    """
+    out = {}
+    for k, v in sd.items():
+        if k.endswith("weight_g"):
+            base = k[: -len("weight_g")]
+            vv = sd[base + "weight_v"]
+            axes = tuple(range(1, vv.ndim))
+            norm = np.sqrt((vv**2).sum(axis=axes, keepdims=True))
+            out[base + "weight"] = (v * vv / np.maximum(norm, 1e-12)).astype(vv.dtype)
+        elif k.endswith("weight_v"):
+            continue
+        else:
+            out[k] = v
+    return out
+
+
+def _conv1d(sd, prefix):
+    """torch Conv1d [out, in, k] -> flax {kernel [k, in, out], bias}."""
+    entry = {"kernel": sd[prefix + ".weight"].transpose(2, 1, 0)}
+    if prefix + ".bias" in sd:
+        entry["bias"] = sd[prefix + ".bias"]
+    return entry
+
+
+def convert_hifigan(sd: Dict[str, np.ndarray]) -> Dict:
+    """Generator state_dict -> params tree for models/hifigan.py.
+
+    Our TorchConvTranspose1d stores its kernel in torch's native
+    [in, out, k] layout, so ups_* weights pass through untransposed.
+    """
+    sd = fold_weight_norm(sd)
+    params: Dict = {}
+    params["conv_pre"] = {"conv": _conv1d(sd, "conv_pre")}
+    params["conv_post"] = {"conv": _conv1d(sd, "conv_post")}
+
+    n_ups = len([k for k in sd if k.startswith("ups.") and k.endswith(".weight")])
+    for i in range(n_ups):
+        params[f"ups_{i}"] = {
+            "kernel": sd[f"ups.{i}.weight"],
+            "bias": sd[f"ups.{i}.bias"],
+        }
+
+    n_res = len(
+        {k.split(".")[1] for k in sd if k.startswith("resblocks.")}
+    )
+    for n in range(n_res):
+        block: Dict = {}
+        for branch in ("convs1", "convs2"):
+            j = 0
+            while f"resblocks.{n}.{branch}.{j}.weight" in sd:
+                block[f"{branch}_{j}"] = {
+                    "conv": _conv1d(sd, f"resblocks.{n}.{branch}.{j}")
+                }
+                j += 1
+        params[f"resblocks_{n}"] = block
+    return params

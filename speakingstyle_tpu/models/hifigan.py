@@ -1,0 +1,169 @@
+"""HiFi-GAN generator in Flax (mel -> waveform vocoder).
+
+Architecture parity with the vendored generator (reference:
+hifigan/models.py:112-174, hifigan/config.json): conv_pre(512, k7) -> 4×
+[transposed-conv upsample (rates 8,8,2,2 / kernels 16,16,4,4) + multi-
+receptive-field fusion of 3 ResBlocks (k=3,7,11; dilations 1,3,5)] ->
+conv_post -> tanh. LeakyReLU slope 0.1.
+
+Conv semantics deliberately mirror torch's (symmetric integer padding;
+transposed conv expressed as an lhs-dilated conv with a flipped kernel) so
+the PyTorch->Flax weight converter (compat/) is a pure layout transpose +
+weight-norm fold with bit-level parity, testable against torch on CPU.
+Channels-last layout throughout so XLA maps the convs onto the MXU.
+"""
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LRELU_SLOPE = 0.1
+
+
+class TorchConv1d(nn.Module):
+    """Conv1d with torch padding semantics: pad = (k*d - d) // 2 per side."""
+
+    features: int
+    kernel_size: int
+    dilation: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        pad = (self.kernel_size * self.dilation - self.dilation) // 2
+        return nn.Conv(
+            self.features,
+            kernel_size=(self.kernel_size,),
+            kernel_dilation=(self.dilation,),
+            padding=[(pad, pad)],
+            dtype=self.dtype,
+            name="conv",
+        )(x)
+
+
+class TorchConvTranspose1d(nn.Module):
+    """ConvTranspose1d(stride=u, padding=(k-u)//2) with exact torch output
+    length L*u: an lhs-dilated conv with the kernel flipped in time and
+    in/out transposed — the standard transpose-conv equivalence."""
+
+    features: int
+    kernel_size: int
+    stride: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        k, u = self.kernel_size, self.stride
+        p = (k - u) // 2
+        in_ch = x.shape[-1]
+        # torch ConvTranspose1d weight layout: [in, out, k]
+        kernel = self.param(
+            "kernel",
+            nn.initializers.normal(0.01),
+            (in_ch, self.features, k),
+            jnp.float32,
+        )
+        # flip time axis, reorder to [k, in, out] for lax
+        w = jnp.flip(kernel, axis=-1).transpose(2, 0, 1).astype(self.dtype)
+        bias = self.param("bias", nn.initializers.zeros, (self.features,), jnp.float32)
+        out = jax.lax.conv_general_dilated(
+            x.astype(self.dtype),
+            w,
+            window_strides=(1,),
+            padding=[(k - 1 - p, k - 1 - p)],
+            lhs_dilation=(u,),
+            dimension_numbers=("NLC", "LIO", "NLC"),
+        )
+        return out + bias.astype(self.dtype)
+
+
+class ResBlock(nn.Module):
+    """MRF residual block (reference: hifigan/models.py:20-109, resblock '1')."""
+
+    channels: int
+    kernel_size: int = 3
+    dilations: Tuple[int, ...] = (1, 3, 5)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        for i, d in enumerate(self.dilations):
+            y = nn.leaky_relu(x, LRELU_SLOPE)
+            y = TorchConv1d(
+                self.channels, self.kernel_size, dilation=d, dtype=self.dtype,
+                name=f"convs1_{i}",
+            )(y)
+            y = nn.leaky_relu(y, LRELU_SLOPE)
+            y = TorchConv1d(
+                self.channels, self.kernel_size, dilation=1, dtype=self.dtype,
+                name=f"convs2_{i}",
+            )(y)
+            x = x + y
+        return x
+
+
+class Generator(nn.Module):
+    """mel [B, T, n_mels] -> wav [B, T * prod(upsample_rates)]."""
+
+    upsample_rates: Sequence[int] = (8, 8, 2, 2)
+    upsample_kernel_sizes: Sequence[int] = (16, 16, 4, 4)
+    upsample_initial_channel: int = 512
+    resblock_kernel_sizes: Sequence[int] = (3, 7, 11)
+    resblock_dilation_sizes: Sequence[Tuple[int, ...]] = ((1, 3, 5), (1, 3, 5), (1, 3, 5))
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, mel):
+        x = TorchConv1d(
+            self.upsample_initial_channel, 7, dtype=self.dtype, name="conv_pre"
+        )(mel)
+        num_kernels = len(self.resblock_kernel_sizes)
+        for i, (u, k) in enumerate(zip(self.upsample_rates, self.upsample_kernel_sizes)):
+            x = nn.leaky_relu(x, LRELU_SLOPE)
+            ch = self.upsample_initial_channel // (2 ** (i + 1))
+            x = TorchConvTranspose1d(
+                ch, k, u, dtype=self.dtype, name=f"ups_{i}"
+            )(x)
+            xs = None
+            for j, (rk, rd) in enumerate(
+                zip(self.resblock_kernel_sizes, self.resblock_dilation_sizes)
+            ):
+                y = ResBlock(
+                    ch, rk, tuple(rd), dtype=self.dtype,
+                    name=f"resblocks_{i * num_kernels + j}",
+                )(x)
+                xs = y if xs is None else xs + y
+            x = xs / num_kernels
+        x = nn.leaky_relu(x, LRELU_SLOPE)
+        x = TorchConv1d(1, 7, dtype=self.dtype, name="conv_post")(x)
+        return jnp.tanh(x)[..., 0].astype(jnp.float32)
+
+
+def generator_from_config(config: dict, dtype=jnp.float32) -> Generator:
+    """Build from a hifigan config.json dict (reference: hifigan/config.json)."""
+    return Generator(
+        upsample_rates=tuple(config["upsample_rates"]),
+        upsample_kernel_sizes=tuple(config["upsample_kernel_sizes"]),
+        upsample_initial_channel=config["upsample_initial_channel"],
+        resblock_kernel_sizes=tuple(config["resblock_kernel_sizes"]),
+        resblock_dilation_sizes=tuple(
+            tuple(d) for d in config["resblock_dilation_sizes"]
+        ),
+        dtype=dtype,
+    )
+
+
+def vocoder_infer(generator, params, mels, lengths=None, max_wav_value=32768.0):
+    """Batch mel [B, T, n_mels] -> list of int16-scaled float wavs trimmed to
+    true lengths (reference: utils/model.py:97-115)."""
+    wavs = generator.apply({"params": params}, mels)
+    wavs = np.asarray(wavs) * max_wav_value
+    out = []
+    hop_factor = int(np.prod(generator.upsample_rates))
+    for i in range(wavs.shape[0]):
+        n = wavs.shape[1] if lengths is None else int(lengths[i]) * hop_factor
+        out.append(wavs[i, :n])
+    return out
