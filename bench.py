@@ -1,0 +1,91 @@
+"""Headline benchmark: FastSpeech2 training throughput in mel-frames/sec.
+
+Measures the full jitted training step (fwd + bwd + optimizer) on the
+flagship model at the reference's paper config scale — batch 48, ~600 mel
+frames per utterance ≈ 29k mel frames per step (SURVEY.md §6) — and prints
+ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+`vs_baseline` is relative to an estimated single-A100 PyTorch throughput of
+the reference at the same batch geometry (no published numbers exist;
+BASELINE.json "published": {}). The estimate is documented in
+A100_BASELINE_FRAMES_PER_SEC; the ≥3× north-star target corresponds to
+vs_baseline ≥ 3.0.
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.models.factory import build_model, init_variables
+from speakingstyle_tpu.training.optim import make_optimizer
+from speakingstyle_tpu.training.state import TrainState
+from speakingstyle_tpu.training.trainer import make_train_step
+
+# Estimated reference (PyTorch, unoptimized research code, fp32, Python
+# length-regulator loop) single-A100 training throughput at batch 48 ×
+# ~600 frames. No published number exists; this anchors vs_baseline.
+A100_BASELINE_FRAMES_PER_SEC = 250_000.0
+
+B, L_SRC, T_MEL = 48, 100, 600
+WARMUP_STEPS, BENCH_STEPS = 3, 20
+
+
+def make_batch(n_mels: int, rng: np.random.Generator):
+    d = T_MEL // L_SRC
+    return dict(
+        speakers=jnp.zeros((B,), jnp.int32),
+        texts=jnp.asarray(rng.integers(1, 360, (B, L_SRC)), jnp.int32),
+        src_lens=jnp.full((B,), L_SRC, jnp.int32),
+        mels=jnp.asarray(rng.standard_normal((B, T_MEL, n_mels)), jnp.float32),
+        mel_lens=jnp.full((B,), T_MEL, jnp.int32),
+        pitches=jnp.asarray(rng.standard_normal((B, L_SRC)), jnp.float32),
+        energies=jnp.asarray(rng.standard_normal((B, L_SRC)), jnp.float32),
+        durations=jnp.full((B, L_SRC), d, jnp.int32),
+    )
+
+
+def main():
+    cfg = Config()
+    model = build_model(cfg)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    tx = make_optimizer(cfg.train)
+    state = TrainState.create(variables, tx)
+    train_step = make_train_step(model, tx, cfg, mesh=None)
+
+    batch = make_batch(
+        cfg.preprocess.preprocessing.mel.n_mel_channels,
+        np.random.default_rng(0),
+    )
+    batch = jax.device_put(batch)
+    rng = jax.random.PRNGKey(1)
+
+    for _ in range(WARMUP_STEPS):
+        state, losses = train_step(state, batch, rng)
+    jax.block_until_ready(losses["total_loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(BENCH_STEPS):
+        state, losses = train_step(state, batch, rng)
+    jax.block_until_ready(losses["total_loss"])
+    dt = time.perf_counter() - t0
+
+    frames_per_step = B * T_MEL
+    fps = frames_per_step * BENCH_STEPS / dt
+    print(
+        json.dumps(
+            {
+                "metric": "train_mel_frames_per_sec",
+                "value": round(fps, 1),
+                "unit": "mel-frames/sec/chip",
+                "vs_baseline": round(fps / A100_BASELINE_FRAMES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

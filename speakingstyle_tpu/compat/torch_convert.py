@@ -55,6 +55,154 @@ def _conv1d(sd, prefix):
     return entry
 
 
+def _dense(sd, prefix):
+    """torch Linear [out, in] -> flax {kernel [in, out], bias}."""
+    entry = {"kernel": sd[prefix + ".weight"].T}
+    if prefix + ".bias" in sd:
+        entry["bias"] = sd[prefix + ".bias"]
+    return entry
+
+
+def _ln(sd, prefix):
+    """torch LayerNorm/BatchNorm affine -> flax {scale, bias}."""
+    return {"scale": sd[prefix + ".weight"], "bias": sd[prefix + ".bias"]}
+
+
+def _embed(sd, prefix):
+    return {"embedding": sd[prefix + ".weight"]}
+
+
+def _film(sd, prefix):
+    return {"s_gamma": sd[prefix + ".s_gamma"], "s_beta": sd[prefix + ".s_beta"]}
+
+
+def _fft_block(sd, prefix):
+    """FFTBlock (reference: transformer/Layers.py:11-37) -> models/layers.py
+    FFTBlock params. The optional per-block FiLM maps only when present."""
+    block = {
+        "slf_attn": {
+            "w_qs": _dense(sd, prefix + ".slf_attn.w_qs"),
+            "w_ks": _dense(sd, prefix + ".slf_attn.w_ks"),
+            "w_vs": _dense(sd, prefix + ".slf_attn.w_vs"),
+            "fc": _dense(sd, prefix + ".slf_attn.fc"),
+            "layer_norm": _ln(sd, prefix + ".slf_attn.layer_norm"),
+        },
+        "pos_ffn": {
+            "w_1": _conv1d(sd, prefix + ".pos_ffn.w_1"),
+            "w_2": _conv1d(sd, prefix + ".pos_ffn.w_2"),
+            "layer_norm": _ln(sd, prefix + ".pos_ffn.layer_norm"),
+        },
+    }
+    if prefix + ".film.s_gamma" in sd:
+        block["film"] = _film(sd, prefix + ".film")
+    return block
+
+
+def _fft_stack(sd, prefix):
+    """ModuleList of FFTBlocks -> FFTStack {layer_i: ...}."""
+    stack = {}
+    i = 0
+    while f"{prefix}.{i}.slf_attn.w_qs.weight" in sd:
+        stack[f"layer_{i}"] = _fft_block(sd, f"{prefix}.{i}")
+        i += 1
+    return stack
+
+
+def _variance_predictor(sd, prefix, film: bool):
+    """reference: model/modules.py:204-259. `film` selects whether the
+    predictor's FiLM gates are live in our graph (duration predictor only —
+    the torch ckpt carries unused film params for pitch/energy which our
+    pitch/energy predictors never instantiate, model/modules.py:122-131)."""
+    vp = {
+        "conv1d_1": _conv1d(sd, prefix + ".conv_layer.conv1d_1.conv"),
+        "layer_norm_1": _ln(sd, prefix + ".conv_layer.layer_norm_1"),
+        "conv1d_2": _conv1d(sd, prefix + ".conv_layer.conv1d_2.conv"),
+        "layer_norm_2": _ln(sd, prefix + ".conv_layer.layer_norm_2"),
+        "linear_layer": _dense(sd, prefix + ".linear_layer"),
+    }
+    if film and prefix + ".film.s_gamma" in sd:
+        # absent in vanilla ming024-style FastSpeech2 checkpoints
+        vp["film"] = _film(sd, prefix + ".film")
+    return vp
+
+
+def convert_fastspeech2(sd: Dict[str, np.ndarray]) -> Dict:
+    """Acoustic-model state_dict (``torch.load(...)["model"]``, reference:
+    train.py:155-165) -> {"params", "batch_stats"} for models/fastspeech2.py.
+
+    Non-trainable buffers that our graph bakes in as constants are skipped:
+    ``*.position_enc`` (sinusoid PE recomputed at trace time) and
+    ``variance_adaptor.{pitch,energy}_bins`` (compile-time constants from
+    stats.json). PostNet BatchNorm running stats land in batch_stats.
+    """
+    # DataParallel checkpoints prefix every key with "module."
+    sd = {k.removeprefix("module."): v for k, v in sd.items()}
+
+    params: Dict = {
+        "encoder": {
+            "src_word_emb": _embed(sd, "encoder.src_word_emb"),
+            "layer_stack": _fft_stack(sd, "encoder.layer_stack"),
+        },
+        "decoder": {
+            "layer_stack": _fft_stack(sd, "decoder.layer_stack"),
+        },
+        "mel_linear": _dense(sd, "mel_linear"),
+    }
+    if "speaker_emb.weight" in sd:
+        params["speaker_emb"] = _embed(sd, "speaker_emb")
+
+    va = {
+        "duration_predictor": _variance_predictor(
+            sd, "variance_adaptor.duration_predictor", film=True
+        ),
+        "pitch_predictor": _variance_predictor(
+            sd, "variance_adaptor.pitch_predictor", film=False
+        ),
+        "energy_predictor": _variance_predictor(
+            sd, "variance_adaptor.energy_predictor", film=False
+        ),
+        "pitch_embedding": _embed(sd, "variance_adaptor.pitch_embedding"),
+        "energy_embedding": _embed(sd, "variance_adaptor.energy_embedding"),
+    }
+    params["variance_adaptor"] = va
+
+    if "reference_encoder.fftb_linear.linear.weight" in sd:
+        re: Dict = {}
+        i = 0
+        while f"reference_encoder.layer_stack.{i}.0.conv.weight" in sd:
+            re[f"conv_{i}"] = {
+                "conv": _conv1d(sd, f"reference_encoder.layer_stack.{i}.0.conv")
+            }
+            re[f"ln_{i}"] = _ln(sd, f"reference_encoder.layer_stack.{i}.2")
+            i += 1
+        re["fftb_linear"] = {
+            "linear": _dense(sd, "reference_encoder.fftb_linear.linear")
+        }
+        j = 0
+        while f"reference_encoder.fftb_stack.{j}.slf_attn.w_qs.weight" in sd:
+            re[f"fftb_{j}"] = _fft_block(sd, f"reference_encoder.fftb_stack.{j}")
+            j += 1
+        re["feature_wise_affine"] = {
+            "linear": _dense(sd, "reference_encoder.feature_wise_affine.linear")
+        }
+        params["reference_encoder"] = re
+
+    postnet: Dict = {}
+    postnet_stats: Dict = {}
+    i = 0
+    while f"postnet.convolutions.{i}.0.conv.weight" in sd:
+        postnet[f"conv_{i}"] = _conv1d(sd, f"postnet.convolutions.{i}.0.conv")
+        postnet[f"bn_{i}"] = _ln(sd, f"postnet.convolutions.{i}.1")
+        postnet_stats[f"bn_{i}"] = {
+            "mean": sd[f"postnet.convolutions.{i}.1.running_mean"],
+            "var": sd[f"postnet.convolutions.{i}.1.running_var"],
+        }
+        i += 1
+    params["postnet"] = postnet
+
+    return {"params": params, "batch_stats": {"postnet": postnet_stats}}
+
+
 def convert_hifigan(sd: Dict[str, np.ndarray]) -> Dict:
     """Generator state_dict -> params tree for models/hifigan.py.
 

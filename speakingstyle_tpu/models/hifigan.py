@@ -24,7 +24,13 @@ LRELU_SLOPE = 0.1
 
 
 class TorchConv1d(nn.Module):
-    """Conv1d with torch padding semantics: pad = (k*d - d) // 2 per side."""
+    """Conv1d with torch padding semantics: pad = (k*d - d) // 2 per side.
+
+    Kept separate from models/layers.py ConvNorm on purpose: this module's
+    contract is bit-parity with the torch vocoder checkpoints (the two only
+    diverge for even kernel sizes, but the parity tests pin THIS padding
+    arithmetic, and the acoustic-model ConvNorm is free to evolve).
+    """
 
     features: int
     kernel_size: int
@@ -144,6 +150,13 @@ class Generator(nn.Module):
 
 def generator_from_config(config: dict, dtype=jnp.float32) -> Generator:
     """Build from a hifigan config.json dict (reference: hifigan/config.json)."""
+    resblock = str(config.get("resblock", "1"))
+    if resblock != "1":
+        raise NotImplementedError(
+            f"resblock type {resblock!r} (ResBlock2, VCTK V2/V3 checkpoints) "
+            "is not supported; only resblock '1' (the reference's "
+            "generator_{LJSpeech,universal}) is implemented"
+        )
     return Generator(
         upsample_rates=tuple(config["upsample_rates"]),
         upsample_kernel_sizes=tuple(config["upsample_kernel_sizes"]),
@@ -157,10 +170,13 @@ def generator_from_config(config: dict, dtype=jnp.float32) -> Generator:
 
 
 def vocoder_infer(generator, params, mels, lengths=None, max_wav_value=32768.0):
-    """Batch mel [B, T, n_mels] -> list of int16-scaled float wavs trimmed to
-    true lengths (reference: utils/model.py:97-115)."""
+    """Batch mel [B, T, n_mels] -> list of int16 wavs trimmed to true
+    lengths (reference: utils/model.py:97-115, which scales by
+    max_wav_value and casts to int16)."""
     wavs = generator.apply({"params": params}, mels)
-    wavs = np.asarray(wavs) * max_wav_value
+    wavs = np.clip(
+        np.asarray(wavs) * max_wav_value, -max_wav_value, max_wav_value - 1
+    ).astype(np.int16)
     out = []
     hop_factor = int(np.prod(generator.upsample_rates))
     for i in range(wavs.shape[0]):
