@@ -1,0 +1,30 @@
+"""Corpus adapters: raw dataset layout -> MFA-ready ``raw_path`` tree.
+
+Each adapter emits ``<raw_path>/<speaker>/<base>.wav`` (target sampling
+rate, peak-normalized int16) plus a cleaned ``.lab`` transcript — the
+layout the Montreal Forced Aligner and the Preprocessor consume (reference:
+preprocessor/{ljspeech,libritts,aishell3,bc_2013}.py). All adapters share
+one multiprocessing fan-out (the reference parallelized only BC2013, via a
+dask/joblib stack this framework does not need).
+"""
+
+from typing import Callable, Dict
+
+from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.data.corpora import aishell3, bc2013, ljspeech, libritts
+
+_ADAPTERS: Dict[str, Callable[[Config], None]] = {
+    "LJSpeech": ljspeech.prepare_align,
+    "LJSpeech_paper": ljspeech.prepare_align,
+    "LibriTTS": libritts.prepare_align,
+    "AISHELL3": aishell3.prepare_align,
+    "BC2013": bc2013.prepare_align,
+}
+
+
+def prepare_align(config: Config) -> None:
+    """Dispatch on ``preprocess.dataset`` (reference: prepare_align.py:8-26)."""
+    name = config.preprocess.dataset
+    if name not in _ADAPTERS:
+        raise ValueError(f"unknown dataset {name!r}; known: {sorted(_ADAPTERS)}")
+    _ADAPTERS[name](config)

@@ -1,0 +1,38 @@
+"""AISHELL-3 adapter: train/test content.txt -> raw_path tree.
+
+Reference: preprocessor/aishell3.py:9-35 — Mandarin corpus; each
+content.txt line is ``<wav_name>\\t<char pinyin char pinyin ...>``; the
+transcript kept is the pinyin stream (odd tokens), uncleaned; the speaker
+id is the first 7 chars of the wav name.
+"""
+
+import os
+
+from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.data.corpora.common import RawUtterance, convert_corpus
+
+
+def prepare_align(config: Config, num_workers=None) -> int:
+    in_dir = config.preprocess.path.corpus_path
+    utts = []
+    for split in ("train", "test"):
+        content = os.path.join(in_dir, split, "content.txt")
+        if not os.path.exists(content):
+            continue
+        with open(content, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip("\n")
+                if "\t" not in line:
+                    continue
+                wav_name, text = line.split("\t", 1)
+                speaker = wav_name[:7]
+                pinyin = text.split(" ")[1::2]
+                utts.append(
+                    RawUtterance(
+                        speaker=speaker,
+                        basename=wav_name[:-4] if wav_name.endswith(".wav") else wav_name,
+                        wav_path=os.path.join(in_dir, split, "wav", speaker, wav_name),
+                        text=" ".join(pinyin),
+                    )
+                )
+    return convert_corpus(utts, config, cleaners=None, num_workers=num_workers)

@@ -1,0 +1,34 @@
+"""Blizzard Challenge 2013 adapter: trainset-transcript.csv -> raw_path.
+
+Reference: preprocessor/bc_2013.py:38-76 — single speaker "CB"; transcript
+lines are ``<base>||<text>|...``; the reference parallelized this corpus
+with joblib+dask, which here is the same process-pool fan-out every adapter
+uses (data/corpora/common.py).
+"""
+
+import os
+
+from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.data.corpora.common import RawUtterance, convert_corpus
+
+
+def prepare_align(config: Config, num_workers=None) -> int:
+    in_dir = config.preprocess.path.corpus_path
+    cleaners = list(config.preprocess.preprocessing.text.text_cleaners)
+    utts = []
+    with open(os.path.join(in_dir, "trainset-transcript.csv"), encoding="utf-8") as f:
+        for line in f:
+            parts = line.strip().split("||")
+            if len(parts) < 2:
+                continue
+            base = parts[0]
+            text = parts[1].split("|")[0]
+            utts.append(
+                RawUtterance(
+                    speaker="CB",
+                    basename=base,
+                    wav_path=os.path.join(in_dir, "wavs", f"{base}.wav"),
+                    text=text,
+                )
+            )
+    return convert_corpus(utts, config, cleaners=cleaners, num_workers=num_workers)

@@ -1,0 +1,65 @@
+"""Shared raw-corpus conversion machinery for every adapter."""
+
+import os
+from concurrent.futures import ProcessPoolExecutor
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+import scipy.io.wavfile
+
+from speakingstyle_tpu.audio.tools import load_wav
+from speakingstyle_tpu.text.cleaners import clean_text
+
+
+@dataclass
+class RawUtterance:
+    """One (wav, transcript) pair to convert into the raw_path tree."""
+
+    speaker: str
+    basename: str
+    wav_path: str
+    text: str  # already-read transcript (cleaning happens in the worker)
+
+
+def _convert_one(args):
+    utt, out_dir, sampling_rate, max_wav_value, cleaners = args
+    if not os.path.exists(utt.wav_path):
+        return False
+    spk_dir = os.path.join(out_dir, utt.speaker)
+    wav, _ = load_wav(utt.wav_path, target_sr=sampling_rate)
+    if wav.size == 0:
+        return False  # truncated/corrupt file: skip, don't abort the corpus
+    peak = float(np.max(np.abs(wav))) or 1.0
+    # peak-normalize to max_wav_value then store int16
+    # (reference: preprocessor/ljspeech.py:29-34)
+    pcm = (wav / peak * max_wav_value).clip(-32768, 32767).astype(np.int16)
+    scipy.io.wavfile.write(
+        os.path.join(spk_dir, f"{utt.basename}.wav"), sampling_rate, pcm
+    )
+    text = clean_text(utt.text, cleaners) if cleaners else utt.text
+    with open(os.path.join(spk_dir, f"{utt.basename}.lab"), "w", encoding="utf-8") as f:
+        f.write(text)
+    return True
+
+
+def convert_corpus(
+    utterances: List[RawUtterance],
+    config,
+    cleaners: Optional[List[str]] = None,
+    num_workers: Optional[int] = None,
+) -> int:
+    """Fan the conversions out over a process pool; returns #converted."""
+    pp = config.preprocess.preprocessing
+    out_dir = config.preprocess.path.raw_path
+    for spk in {u.speaker for u in utterances}:
+        os.makedirs(os.path.join(out_dir, spk), exist_ok=True)
+    jobs = [
+        (u, out_dir, pp.audio.sampling_rate, pp.audio.max_wav_value, cleaners)
+        for u in utterances
+    ]
+    num_workers = num_workers or min(os.cpu_count() or 1, 32)
+    if num_workers > 1 and len(jobs) > 8:
+        with ProcessPoolExecutor(max_workers=num_workers) as pool:
+            return sum(pool.map(_convert_one, jobs, chunksize=16))
+    return sum(map(_convert_one, jobs))

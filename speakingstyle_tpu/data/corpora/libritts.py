@@ -1,0 +1,42 @@
+"""LibriTTS adapter: speaker/chapter tree -> raw_path tree.
+
+Reference: preprocessor/libritts.py:11-46 — one output directory per
+speaker id; transcripts come from the ``*.normalized.txt`` sidecar files.
+"""
+
+import os
+
+from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.data.corpora.common import RawUtterance, convert_corpus
+
+
+def prepare_align(config: Config, num_workers=None) -> int:
+    in_dir = config.preprocess.path.corpus_path
+    cleaners = list(config.preprocess.preprocessing.text.text_cleaners)
+    utts = []
+    for speaker in sorted(os.listdir(in_dir)):
+        spk_dir = os.path.join(in_dir, speaker)
+        if not os.path.isdir(spk_dir):
+            continue
+        for chapter in sorted(os.listdir(spk_dir)):
+            ch_dir = os.path.join(spk_dir, chapter)
+            if not os.path.isdir(ch_dir):
+                continue
+            for name in sorted(os.listdir(ch_dir)):
+                if not name.endswith(".wav"):
+                    continue
+                base = name[:-4]
+                txt = os.path.join(ch_dir, f"{base}.normalized.txt")
+                if not os.path.exists(txt):
+                    continue
+                with open(txt, encoding="utf-8") as f:
+                    text = f.readline().strip("\n")
+                utts.append(
+                    RawUtterance(
+                        speaker=speaker,
+                        basename=base,
+                        wav_path=os.path.join(ch_dir, name),
+                        text=text,
+                    )
+                )
+    return convert_corpus(utts, config, cleaners=cleaners, num_workers=num_workers)

@@ -1,0 +1,32 @@
+"""LJSpeech adapter: metadata.csv + wavs/ -> raw_path tree.
+
+Reference: preprocessor/ljspeech.py:11-39 — single pseudo-speaker
+"LJSpeech"; transcripts come from the *normalized* third column and are run
+through the configured cleaners.
+"""
+
+import os
+
+from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.data.corpora.common import RawUtterance, convert_corpus
+
+
+def prepare_align(config: Config, num_workers=None) -> int:
+    in_dir = config.preprocess.path.corpus_path
+    cleaners = list(config.preprocess.preprocessing.text.text_cleaners)
+    utts = []
+    with open(os.path.join(in_dir, "metadata.csv"), encoding="utf-8") as f:
+        for line in f:
+            parts = line.strip().split("|")
+            if len(parts) < 3:
+                continue
+            base, text = parts[0], parts[2]
+            utts.append(
+                RawUtterance(
+                    speaker="LJSpeech",
+                    basename=base,
+                    wav_path=os.path.join(in_dir, "wavs", f"{base}.wav"),
+                    text=text,
+                )
+            )
+    return convert_corpus(utts, config, cleaners=cleaners, num_workers=num_workers)

@@ -1,0 +1,127 @@
+"""Fundamental-frequency (F0) extraction for preprocessing.
+
+The reference extracts F0 with pyworld's DIO + StoneMask
+(reference: preprocessor/preprocessor.py:182-187); pyworld is kept as the
+preferred backend when installed. The built-in fallback is a vectorized
+normalized-autocorrelation tracker (YIN-style difference function computed
+for all frames at once via FFT) so the framework has no hard native
+dependency. Both return the reference's contract: one F0 value per hop,
+0.0 on unvoiced frames.
+"""
+
+from typing import Optional
+
+import numpy as np
+
+
+def extract_f0(
+    wav: np.ndarray,
+    sampling_rate: int,
+    hop_length: int,
+    f0_floor: float = 71.0,
+    f0_ceil: float = 800.0,
+) -> np.ndarray:
+    """wav [T] float in [-1,1] -> f0 [n_frames] Hz, 0 where unvoiced."""
+    try:
+        import pyworld as pw  # optional native backend
+
+        f0, t = pw.dio(
+            wav.astype(np.float64),
+            sampling_rate,
+            frame_period=hop_length / sampling_rate * 1000,
+        )
+        return pw.stonemask(wav.astype(np.float64), f0, t, sampling_rate)
+    except ImportError:
+        return yin_f0(wav, sampling_rate, hop_length, f0_floor, f0_ceil)
+
+
+def _difference_function(frames: np.ndarray, max_lag: int) -> np.ndarray:
+    """Batched YIN difference d[t, tau] for tau in [0, max_lag).
+
+    d(tau) = sum_j (x_j - x_{j+tau})^2 = r(0)|_0 + r(0)|_tau - 2*acf(tau),
+    with the autocorrelation computed for all frames via one real FFT.
+    """
+    n_frames, w = frames.shape
+    # autocorrelation via FFT (zero-padded to avoid circular wrap)
+    nfft = 1
+    while nfft < 2 * w:
+        nfft *= 2
+    spec = np.fft.rfft(frames, nfft, axis=1)
+    acf = np.fft.irfft(spec * np.conj(spec), nfft, axis=1)[:, :max_lag]
+
+    # cumulative energies of the leading / trailing windows
+    sq = frames**2
+    csum = np.concatenate(
+        [np.zeros((n_frames, 1)), np.cumsum(sq, axis=1)], axis=1
+    )  # [n, w+1]
+    total = csum[:, w : w + 1]
+    lags = np.arange(max_lag)
+    # energy of x[0 : w-tau] and of x[tau : w]
+    e_head = csum[:, w - lags]
+    e_tail = total - csum[:, lags]
+    return e_head + e_tail - 2.0 * acf
+
+
+def yin_f0(
+    wav: np.ndarray,
+    sampling_rate: int,
+    hop_length: int,
+    f0_floor: float = 71.0,
+    f0_ceil: float = 800.0,
+    threshold: float = 0.15,
+    frame_length: Optional[int] = None,
+) -> np.ndarray:
+    """Vectorized YIN pitch tracking (de Cheveigné & Kawahara 2002).
+
+    All frames are processed as one [n_frames, window] batch: FFT
+    autocorrelation -> cumulative-mean-normalized difference -> absolute
+    threshold -> parabolic interpolation. Frame count matches pyworld's
+    ``len(wav)//hop + 1`` so downstream mel-length slicing is unchanged.
+    """
+    wav = np.asarray(wav, np.float64)
+    max_lag = int(sampling_rate / f0_floor) + 2
+    min_lag = max(2, int(sampling_rate / f0_ceil))
+    w = frame_length or 2 * max_lag
+
+    n_frames = len(wav) // hop_length + 1
+    pad = w  # center frames on t*hop like pyworld's time axis
+    padded = np.pad(wav, (pad // 2, pad), mode="constant")
+    starts = np.arange(n_frames) * hop_length
+    frames = padded[starts[:, None] + np.arange(w)[None, :]]  # [n, w]
+    frames = frames - frames.mean(axis=1, keepdims=True)
+
+    d = _difference_function(frames, max_lag)  # [n, max_lag]
+    # cumulative mean normalized difference: d'(0)=1, d'(tau)=d(tau)*tau/cumsum(d)
+    taus = np.arange(1, max_lag)
+    cmnd = np.ones_like(d)
+    denom = np.cumsum(d[:, 1:], axis=1)
+    cmnd[:, 1:] = d[:, 1:] * taus[None, :] / np.maximum(denom, 1e-12)
+
+    region = cmnd[:, min_lag:max_lag]
+    below = region < threshold
+    has_dip = below.any(axis=1)
+    idx = np.arange(region.shape[0])
+    # YIN picks the *minimum of the first dip* under the threshold: find the
+    # first below-threshold lag, then argmin over its contiguous run
+    first = np.argmax(below, axis=1)
+    runs = np.cumsum(~below, axis=1)  # constant within a below-threshold run
+    in_first_run = below & (runs == runs[idx, first][:, None])
+    dip_min = np.argmin(np.where(in_first_run, region, np.inf), axis=1)
+    best = np.where(has_dip, dip_min, np.argmin(region, axis=1)) + min_lag
+
+    # parabolic interpolation around the chosen lag
+    b = np.clip(best, 1, max_lag - 2)
+    y0, y1, y2 = cmnd[idx, b - 1], cmnd[idx, b], cmnd[idx, b + 1]
+    denom2 = y0 - 2 * y1 + y2
+    well_formed = np.abs(denom2) > 1e-12
+    safe = np.where(well_formed, denom2, 1.0)
+    offset = np.clip(np.where(well_formed, (y0 - y2) / (2.0 * safe), 0.0), -1.0, 1.0)
+    lag = b + offset
+
+    f0 = sampling_rate / np.maximum(lag, 1e-6)
+    dip_depth = cmnd[idx, b]
+    # voiced if a clear periodicity dip exists and frame has energy
+    energy = np.sqrt((frames**2).mean(axis=1))
+    voiced = (dip_depth < 2 * threshold) & (energy > 1e-4)
+    voiced &= (f0 >= f0_floor) & (f0 <= f0_ceil)
+    return np.where(voiced, f0, 0.0)

@@ -1,0 +1,397 @@
+"""Offline feature extraction: MFA TextGrids + wavs -> training features.
+
+Behavioral port of the reference pipeline (reference:
+preprocessor/preprocessor.py:16-314): per utterance, read the phone tier,
+trim leading/trailing silences, slice the wav to the aligned span, extract
+F0 / mel / energy, phoneme-average pitch (after linear interpolation over
+unvoiced frames) and energy, then z-normalize the whole corpus with running
+statistics and emit stats.json / speakers.json / train-val metadata.
+
+Redesigned for this framework:
+  * utterances fan out over a multiprocessing pool (the reference is serial;
+    its BC2013 adapter bolted on dask — SURVEY.md §7 step 3),
+  * phoneme averaging is a vectorized ``np.add.reduceat``, not a Python loop,
+  * no tgt/librosa/sklearn/pyworld hard deps — TextGrid parsing, resampling,
+    running moments, and YIN F0 are self-contained (data/textgrid.py,
+    audio/tools.py, data/f0.py; pyworld is used when installed),
+  * the constructor takes the typed Config (fixing the reference's
+    preprocess.py:16 TypeError, SURVEY.md §2.5).
+"""
+
+import json
+import os
+import random
+from concurrent.futures import ProcessPoolExecutor
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from speakingstyle_tpu.audio.mel import mel_filterbank
+from speakingstyle_tpu.audio.stft import hann_window
+from speakingstyle_tpu.audio.tools import load_wav
+from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.data.f0 import extract_f0
+from speakingstyle_tpu.data.textgrid import read_textgrid
+
+SILENCE_PHONES = ("sil", "sp", "spn", "")
+
+
+def get_alignment(
+    intervals: Sequence[Tuple[float, float, str]],
+    sampling_rate: int,
+    hop_length: int,
+) -> Tuple[List[str], List[int], float, float]:
+    """Phone tier -> (phones, durations_in_hops, start_s, end_s).
+
+    Leading and trailing silences are dropped; internal silences are kept.
+    Durations are differences of hop-rounded boundaries so they sum exactly
+    to the hop-count of the kept span (reference: preprocessor.py:253-291).
+    """
+    phones: List[str] = []
+    durations: List[int] = []
+    start_time = end_time = 0.0
+    end_idx = 0
+    for s, e, p in intervals:
+        p = p.strip()
+        if not phones:
+            if p in SILENCE_PHONES:
+                continue  # leading silence
+            start_time = s
+        if p in SILENCE_PHONES:
+            phones.append("sp" if p == "" else p)
+        else:
+            phones.append(p)
+            end_time = e
+            end_idx = len(phones)
+        durations.append(
+            int(
+                np.round(e * sampling_rate / hop_length)
+                - np.round(s * sampling_rate / hop_length)
+            )
+        )
+    return phones[:end_idx], durations[:end_idx], start_time, end_time
+
+
+def phoneme_average(values: np.ndarray, durations: Sequence[int]) -> np.ndarray:
+    """Mean of each phoneme's frame span; 0 for zero-duration phones.
+
+    Vectorized replacement for the reference's per-phone loop
+    (preprocessor.py:209-228).
+    """
+    durations = np.asarray(durations, np.int64)
+    n = int(durations.sum())
+    values = np.asarray(values, np.float64)[:n]
+    starts = np.concatenate([[0], np.cumsum(durations)[:-1]])
+    # reduceat needs strictly valid indices; zero-duration spans share their
+    # start with the next phone — mask them to 0 afterwards
+    sums = np.add.reduceat(values, np.minimum(starts, max(n - 1, 0)))
+    # reduceat sums to the next index; for zero-duration entries it returns
+    # the next span's sum, so divide by duration and zero them explicitly
+    out = np.where(durations > 0, sums / np.maximum(durations, 1), 0.0)
+    return out.astype(np.float32)
+
+
+def interpolate_unvoiced(pitch: np.ndarray) -> np.ndarray:
+    """Linear interpolation over zero (unvoiced) frames, edge-held."""
+    pitch = np.asarray(pitch, np.float64).copy()
+    voiced = np.nonzero(pitch != 0)[0]
+    if len(voiced) == 0:
+        return pitch
+    pitch = np.interp(np.arange(len(pitch)), voiced, pitch[voiced])
+    return pitch
+
+
+def remove_outliers(values: np.ndarray) -> np.ndarray:
+    """Drop values outside the 1.5-IQR fence (reference: preprocessor.py:293-301)."""
+    values = np.asarray(values)
+    if values.size == 0:
+        return values
+    p25, p75 = np.percentile(values, (25, 75))
+    fence = 1.5 * (p75 - p25)
+    return values[(values > p25 - fence) & (values < p75 + fence)]
+
+
+class RunningScaler:
+    """Welford running mean/std over partial batches (replaces sklearn's
+    StandardScaler.partial_fit; reference: preprocessor.py:62-63,86-88)."""
+
+    def __init__(self):
+        self.n = 0
+        self.mean = 0.0
+        self.m2 = 0.0
+
+    def partial_fit(self, x: np.ndarray):
+        x = np.asarray(x, np.float64).ravel()
+        if x.size == 0:
+            return
+        n_b, mean_b = x.size, x.mean()
+        m2_b = ((x - mean_b) ** 2).sum()
+        delta = mean_b - self.mean
+        total = self.n + n_b
+        self.mean += delta * n_b / total
+        self.m2 += m2_b + delta**2 * self.n * n_b / total
+        self.n = total
+
+    @property
+    def std(self) -> float:
+        return float(np.sqrt(self.m2 / self.n)) if self.n > 0 else 1.0
+
+
+def _numpy_mel_energy(
+    wav: np.ndarray,
+    mel_basis: np.ndarray,
+    window: np.ndarray,
+    n_fft: int,
+    hop: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Worker-side mel/energy, bit-matching audio/stft.py's JAX path
+    (reflect pad, periodic hann, |rfft|, mel fb, log-clamp, L2 energy) but in
+    numpy so pool workers never initialize a JAX backend."""
+    pad = n_fft // 2
+    y = np.pad(np.clip(wav, -1.0, 1.0), (pad, pad), mode="reflect")
+    n_frames = (len(y) - n_fft) // hop + 1
+    starts = np.arange(n_frames) * hop
+    frames = y[starts[:, None] + np.arange(n_fft)[None, :]] * window[None, :]
+    mag = np.abs(np.fft.rfft(frames, axis=1)).astype(np.float32)  # [T, F]
+    mel = np.log(np.clip(mag @ mel_basis.T, 1e-5, None))  # [T, n_mels]
+    energy = np.linalg.norm(mag, axis=1)
+    return mel.astype(np.float32), energy.astype(np.float32)
+
+
+@dataclass
+class _Job:
+    speaker: str
+    basename: str
+    wav_path: str
+    lab_path: str
+    tg_path: str
+
+
+_WORKER_CFG = None  # per-process cache: (cfg-extract, mel_basis, window)
+
+
+def _worker_init(params):
+    global _WORKER_CFG
+    sr, n_fft, hop, win, n_mels, fmin, fmax = params
+    _WORKER_CFG = (
+        params,
+        mel_filterbank(sr, n_fft, n_mels, fmin, fmax),
+        hann_window(win, n_fft),
+    )
+
+
+def _process_utterance(job: _Job):
+    """Runs in a pool worker. Returns (metadata_line, pitch, energy,
+    n_frames, features dict) or None to skip the utterance."""
+    params, mel_basis, window = _WORKER_CFG
+    sr, n_fft, hop, win, n_mels, fmin, fmax = params
+
+    tg = read_textgrid(job.tg_path)
+    phones, durations, start, end = get_alignment(tg.get_tier("phones"), sr, hop)
+    if not phones or start >= end:
+        return None
+    text = "{" + " ".join(phones) + "}"
+
+    wav, _ = load_wav(job.wav_path, target_sr=sr)
+    wav = wav[int(sr * start) : int(sr * end)]
+    if wav.size < n_fft:
+        return None
+
+    with open(job.lab_path, encoding="utf-8") as f:
+        raw_text = f.readline().strip("\n")
+
+    n_total = int(sum(durations))
+    pitch = extract_f0(wav, sr, hop)[:n_total]
+    if np.sum(pitch != 0) <= 1:
+        return None
+    mel, energy = _numpy_mel_energy(wav, mel_basis, window, n_fft, hop)
+    mel, energy = mel[:n_total], energy[:n_total]
+
+    return (
+        "|".join([job.basename, job.speaker, text, raw_text]),
+        pitch.astype(np.float32),
+        energy.astype(np.float32),
+        np.asarray(durations, np.int64),
+        mel,
+    )
+
+
+class Preprocessor:
+    """Corpus feature builder (reference: preprocessor/preprocessor.py:16-151)."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        pp = config.preprocess
+        self.in_dir = pp.path.raw_path
+        self.out_dir = pp.path.preprocessed_path
+        self.val_size = pp.preprocessing.val_size
+        self.sampling_rate = pp.preprocessing.audio.sampling_rate
+        self.hop_length = pp.preprocessing.stft.hop_length
+        self.pitch_phoneme_averaging = (
+            pp.preprocessing.pitch.feature == "phoneme_level"
+        )
+        self.energy_phoneme_averaging = (
+            pp.preprocessing.energy.feature == "phoneme_level"
+        )
+        self.pitch_normalization = pp.preprocessing.pitch.normalization
+        self.energy_normalization = pp.preprocessing.energy.normalization
+        self._stft_params = (
+            self.sampling_rate,
+            pp.preprocessing.stft.filter_length,
+            self.hop_length,
+            pp.preprocessing.stft.win_length,
+            pp.preprocessing.mel.n_mel_channels,
+            pp.preprocessing.mel.mel_fmin,
+            pp.preprocessing.mel.mel_fmax,
+        )
+
+    # -- job discovery ------------------------------------------------------
+    def _jobs(self):
+        speakers = {}
+        jobs: List[_Job] = []
+        for speaker in sorted(os.listdir(self.in_dir)):
+            spk_dir = os.path.join(self.in_dir, speaker)
+            if not os.path.isdir(spk_dir):
+                continue
+            speakers[speaker] = len(speakers)
+            for name in sorted(os.listdir(spk_dir)):
+                if not name.endswith(".wav"):
+                    continue
+                base = name[: -len(".wav")]
+                tg = os.path.join(
+                    self.out_dir, "TextGrid", speaker, f"{base}.TextGrid"
+                )
+                if not os.path.exists(tg):
+                    continue
+                jobs.append(
+                    _Job(
+                        speaker=speaker,
+                        basename=base,
+                        wav_path=os.path.join(spk_dir, name),
+                        lab_path=os.path.join(spk_dir, f"{base}.lab"),
+                        tg_path=tg,
+                    )
+                )
+        return speakers, jobs
+
+    # -- main build ---------------------------------------------------------
+    def build_from_path(self, num_workers: Optional[int] = None) -> List[str]:
+        for sub in ("mel", "pitch", "energy", "duration"):
+            os.makedirs(os.path.join(self.out_dir, sub), exist_ok=True)
+        speakers, jobs = self._jobs()
+        if not jobs:
+            raise FileNotFoundError(
+                f"no (wav, TextGrid) pairs under {self.in_dir!r} / "
+                f"{os.path.join(self.out_dir, 'TextGrid')!r}"
+            )
+
+        pitch_scaler, energy_scaler = RunningScaler(), RunningScaler()
+        out: List[str] = []
+        written: List[str] = []  # feature-file tags saved THIS run
+        n_frames = 0
+
+        num_workers = num_workers or min(os.cpu_count() or 1, 32)
+        if num_workers > 1:
+            pool = ProcessPoolExecutor(
+                max_workers=num_workers,
+                initializer=_worker_init,
+                initargs=(self._stft_params,),
+            )
+            results = pool.map(_process_utterance, jobs, chunksize=8)
+        else:
+            _worker_init(self._stft_params)
+            pool = None
+            results = map(_process_utterance, jobs)
+
+        try:
+            for job, ret in zip(jobs, results):
+                if ret is None:
+                    continue
+                info, pitch, energy, durations, mel = ret
+                pitch, energy = self._finalize_features(
+                    job, pitch, energy, durations, mel
+                )
+                written.append(f"{job.speaker}-{{}}-{job.basename}.npy")
+                out.append(info)
+                if pitch.size:
+                    pitch_scaler.partial_fit(remove_outliers(pitch))
+                if energy.size:
+                    energy_scaler.partial_fit(remove_outliers(energy))
+                n_frames += mel.shape[0]
+        finally:
+            if pool is not None:
+                pool.shutdown()
+
+        pitch_mean = pitch_scaler.mean if self.pitch_normalization else 0.0
+        pitch_std = pitch_scaler.std if self.pitch_normalization else 1.0
+        energy_mean = energy_scaler.mean if self.energy_normalization else 0.0
+        energy_std = energy_scaler.std if self.energy_normalization else 1.0
+
+        pitch_min, pitch_max = self._normalize_dir(
+            "pitch", pitch_mean, pitch_std, written
+        )
+        energy_min, energy_max = self._normalize_dir(
+            "energy", energy_mean, energy_std, written
+        )
+
+        with open(os.path.join(self.out_dir, "speakers.json"), "w") as f:
+            json.dump(speakers, f)
+        with open(os.path.join(self.out_dir, "stats.json"), "w") as f:
+            json.dump(
+                {
+                    "pitch": [
+                        float(pitch_min),
+                        float(pitch_max),
+                        float(pitch_mean),
+                        float(pitch_std),
+                    ],
+                    "energy": [
+                        float(energy_min),
+                        float(energy_max),
+                        float(energy_mean),
+                        float(energy_std),
+                    ],
+                },
+                f,
+            )
+
+        hours = n_frames * self.hop_length / self.sampling_rate / 3600
+        print(f"Processed {len(out)} utterances, total {hours:.2f} hours")
+
+        rng = random.Random(self.config.train.seed)
+        rng.shuffle(out)
+        with open(os.path.join(self.out_dir, "train.txt"), "w", encoding="utf-8") as f:
+            f.writelines(m + "\n" for m in out[self.val_size :])
+        with open(os.path.join(self.out_dir, "val.txt"), "w", encoding="utf-8") as f:
+            f.writelines(m + "\n" for m in out[: self.val_size])
+        return out
+
+    def _finalize_features(self, job, pitch, energy, durations, mel):
+        """Phoneme-average (per config), save the four .npy feature files."""
+        if self.pitch_phoneme_averaging:
+            pitch = phoneme_average(interpolate_unvoiced(pitch), durations)
+        if self.energy_phoneme_averaging:
+            energy = phoneme_average(energy, durations)
+        tag = f"{job.speaker}-{{}}-{job.basename}.npy"
+        np.save(
+            os.path.join(self.out_dir, "duration", tag.format("duration")), durations
+        )
+        np.save(os.path.join(self.out_dir, "pitch", tag.format("pitch")), pitch)
+        np.save(os.path.join(self.out_dir, "energy", tag.format("energy")), energy)
+        np.save(os.path.join(self.out_dir, "mel", tag.format("mel")), mel)
+        return pitch, energy
+
+    def _normalize_dir(self, kind: str, mean: float, std: float, written: List[str]):
+        """In-place (x - mean)/std over the files written THIS run (stale
+        files from earlier runs must not be re-normalized); returns (min, max)."""
+        d = os.path.join(self.out_dir, kind)
+        vmin, vmax = np.inf, -np.inf
+        for tag in written:
+            p = os.path.join(d, tag.format(kind))
+            values = (np.load(p) - mean) / std
+            np.save(p, values)
+            if values.size:
+                vmin = min(vmin, float(values.min()))
+                vmax = max(vmax, float(values.max()))
+        return vmin, vmax

@@ -1,0 +1,101 @@
+"""Minimal Praat TextGrid reader (MFA alignment output).
+
+Replaces the reference's `tgt` dependency (reference:
+preprocessor/preprocessor.py:163 uses ``tgt.io.read_textgrid``) with a
+self-contained parser. Handles both the long ("ooTextFile" with named
+fields) and short TextGrid formats, which covers everything the Montreal
+Forced Aligner emits. Only interval tiers are returned; point tiers are
+skipped (MFA never writes them for word/phone alignments).
+"""
+
+import re
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+Interval = Tuple[float, float, str]  # (start_time, end_time, text)
+
+
+@dataclass
+class TextGrid:
+    xmin: float
+    xmax: float
+    tiers: Dict[str, List[Interval]]
+
+    def get_tier(self, name: str) -> List[Interval]:
+        if name not in self.tiers:
+            raise KeyError(f"no tier {name!r}; available: {sorted(self.tiers)}")
+        return self.tiers[name]
+
+
+def _tokenize(text: str):
+    """Yield ('num', float) / ('str', str) tokens in file order.
+
+    Works uniformly for long and short formats: both are just a stream of
+    numbers and quoted strings once field names / 'item [k]:' decoration is
+    stripped, and the header fixes the interpretation order.
+    """
+    for m in re.finditer(r'"(?:[^"]|"")*"|-?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?', text):
+        tok = m.group(0)
+        if tok.startswith('"'):
+            yield ("str", tok[1:-1].replace('""', '"'))
+        else:
+            yield ("num", float(tok))
+
+
+_DECOR_RE = re.compile(r"(?:item|intervals|points)\s*\[\d*\]\s*:")
+
+
+def parse_textgrid(text: str) -> TextGrid:
+    """Parse TextGrid file contents (either format) into tiers of intervals."""
+    if "ooTextFile" not in text[:200]:
+        raise ValueError("not a TextGrid file (missing ooTextFile header)")
+    header_end = text.find("\n", text.find("TextGrid"))
+    body = _DECOR_RE.sub(" ", text[header_end:])
+    toks = list(_tokenize(body))
+    pos = 0
+
+    def num():
+        nonlocal pos
+        while toks[pos][0] != "num":
+            pos += 1
+        v = toks[pos][1]
+        pos += 1
+        return v
+
+    def string():
+        nonlocal pos
+        while toks[pos][0] != "str":
+            pos += 1
+        v = toks[pos][1]
+        pos += 1
+        return v
+
+    # Stream after decoration-stripping is identical in both formats:
+    # xmin xmax [tiers flag — "<exists>" emits no token] size, then per tier:
+    # class name xmin xmax n, then n × (start end label).
+    xmin, xmax = num(), num()
+    n_tiers = int(num())
+
+    tiers: Dict[str, List[Interval]] = {}
+    for _ in range(n_tiers):
+        tier_class = string()  # "IntervalTier" | "TextTier"
+        tier_name = string()
+        t_xmin, t_xmax = num(), num()
+        n_items = int(num())
+        intervals: List[Interval] = []
+        if tier_class == "IntervalTier":
+            for _ in range(n_items):
+                s, e = num(), num()
+                label = string()
+                intervals.append((s, e, label))
+            tiers[tier_name] = intervals
+        else:  # point tier: (time, mark) pairs — parsed to keep stream aligned
+            for _ in range(n_items):
+                num()
+                string()
+    return TextGrid(xmin=xmin, xmax=xmax, tiers=tiers)
+
+
+def read_textgrid(path: str) -> TextGrid:
+    with open(path, encoding="utf-8") as f:
+        return parse_textgrid(f.read())

@@ -39,7 +39,9 @@ class ReferenceEncoder(nn.Module):
 
         Returns (gammas, betas), each [B, 1, d_model].
         """
-        x = mel.astype(self.dtype)
+        # zero padded frames up front: collate pads with zeros in the
+        # reference, and the convs must not read arbitrary padding content
+        x = mask_fill(mel.astype(self.dtype), pad_mask)
         for i in range(self.n_conv_layers):
             x = ConvNorm(
                 self.conv_filter_size,

@@ -25,7 +25,7 @@ def make_lr_schedule(train_cfg: TrainConfig):
     anneal_rate = opt.anneal_rate
 
     def schedule(step):
-        current = step.astype(jnp.float32) + 1.0
+        current = jnp.asarray(step, jnp.float32) + 1.0
         ramp = init_lr + (current / ramp_steps) * (anneal_lr - init_lr)
         n_passed = jnp.sum(current > milestones)
         decayed = anneal_lr * jnp.power(anneal_rate, n_passed)

@@ -1,0 +1,369 @@
+"""Offline preprocessing: TextGrid parsing, F0, alignment, full corpus build."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from speakingstyle_tpu.configs.config import (
+    Config,
+    PathConfig,
+    PreprocessConfig,
+    PreprocessingConfig,
+)
+from speakingstyle_tpu.data.f0 import yin_f0
+from speakingstyle_tpu.data.preprocessor import (
+    Preprocessor,
+    RunningScaler,
+    get_alignment,
+    interpolate_unvoiced,
+    phoneme_average,
+    remove_outliers,
+)
+from speakingstyle_tpu.data.textgrid import parse_textgrid
+
+SR, HOP = 22050, 256
+
+
+# ---------------------------------------------------------------------------
+# TextGrid parser
+# ---------------------------------------------------------------------------
+
+LONG_TG = """File type = "ooTextFile"
+Object class = "TextGrid"
+
+xmin = 0
+xmax = 1.0
+tiers? <exists>
+size = 2
+item []:
+    item [1]:
+        class = "IntervalTier"
+        name = "words"
+        xmin = 0
+        xmax = 1.0
+        intervals: size = 2
+        intervals [1]:
+            xmin = 0
+            xmax = 0.5
+            text = "hello"
+        intervals [2]:
+            xmin = 0.5
+            xmax = 1.0
+            text = ""
+    item [2]:
+        class = "IntervalTier"
+        name = "phones"
+        xmin = 0
+        xmax = 1.0
+        intervals: size = 3
+        intervals [1]:
+            xmin = 0
+            xmax = 0.2
+            text = "HH"
+        intervals [2]:
+            xmin = 0.2
+            xmax = 0.5
+            text = "AH0"
+        intervals [3]:
+            xmin = 0.5
+            xmax = 1.0
+            text = "sp"
+"""
+
+SHORT_TG = """File type = "ooTextFile"
+Object class = "TextGrid"
+
+0
+1.0
+<exists>
+1
+"IntervalTier"
+"phones"
+0
+1.0
+2
+0
+0.6
+"AA1"
+0.6
+1.0
+"sil"
+"""
+
+
+def test_parse_long_textgrid():
+    tg = parse_textgrid(LONG_TG)
+    assert tg.xmax == 1.0
+    assert set(tg.tiers) == {"words", "phones"}
+    phones = tg.get_tier("phones")
+    assert phones == [(0.0, 0.2, "HH"), (0.2, 0.5, "AH0"), (0.5, 1.0, "sp")]
+
+
+def test_parse_short_textgrid():
+    tg = parse_textgrid(SHORT_TG)
+    assert tg.get_tier("phones") == [(0.0, 0.6, "AA1"), (0.6, 1.0, "sil")]
+
+
+def test_parse_textgrid_quoted_escapes():
+    tg = parse_textgrid(LONG_TG.replace('"hello"', '"say ""hi"""'))
+    assert tg.get_tier("words")[0][2] == 'say "hi"'
+
+
+def test_get_tier_missing_raises():
+    with pytest.raises(KeyError):
+        parse_textgrid(SHORT_TG).get_tier("words")
+
+
+# ---------------------------------------------------------------------------
+# Alignment (silence trimming, hop-unit durations)
+# ---------------------------------------------------------------------------
+
+def test_get_alignment_trims_silences():
+    intervals = [
+        (0.0, 0.1, "sil"),   # leading silence dropped
+        (0.1, 0.3, "HH"),
+        (0.3, 0.4, "sp"),    # internal silence kept
+        (0.4, 0.6, "AH0"),
+        (0.6, 1.0, "sil"),   # trailing silence dropped
+    ]
+    phones, durations, start, end = get_alignment(intervals, SR, HOP)
+    assert phones == ["HH", "sp", "AH0"]
+    assert start == pytest.approx(0.1) and end == pytest.approx(0.6)
+    # durations sum to the hop count of [start, end)
+    total = round(0.6 * SR / HOP) - round(0.1 * SR / HOP)
+    assert sum(durations) == total
+    assert all(d >= 0 for d in durations)
+
+
+def test_get_alignment_all_silence():
+    phones, durations, start, end = get_alignment([(0.0, 1.0, "sp")], SR, HOP)
+    assert phones == [] and durations == []
+
+
+# ---------------------------------------------------------------------------
+# Feature post-processing
+# ---------------------------------------------------------------------------
+
+def test_phoneme_average_matches_loop():
+    rng = np.random.default_rng(0)
+    durations = [3, 0, 5, 2]
+    values = rng.standard_normal(sum(durations))
+    out = phoneme_average(values, durations)
+    # reference loop semantics (preprocessor.py:209-228)
+    pos, expected = 0, []
+    for d in durations:
+        expected.append(values[pos : pos + d].mean() if d > 0 else 0.0)
+        pos += d
+    np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+
+def test_interpolate_unvoiced():
+    p = np.array([0.0, 100.0, 0.0, 0.0, 130.0, 0.0])
+    out = interpolate_unvoiced(p)
+    np.testing.assert_allclose(out, [100, 100, 110, 120, 130, 130])
+
+
+def test_remove_outliers():
+    vals = np.array([1.0, 1.1, 0.9, 1.05, 50.0])
+    out = remove_outliers(vals)
+    assert 50.0 not in out and len(out) == 4
+
+
+def test_running_scaler_matches_numpy():
+    rng = np.random.default_rng(1)
+    chunks = [rng.standard_normal(n) * 3 + 7 for n in (10, 50, 1)]
+    sc = RunningScaler()
+    for c in chunks:
+        sc.partial_fit(c)
+    allv = np.concatenate(chunks)
+    assert sc.mean == pytest.approx(allv.mean(), rel=1e-9)
+    assert sc.std == pytest.approx(allv.std(), rel=1e-9)
+
+
+def test_yin_f0_sine_and_silence():
+    t = np.arange(SR) / SR
+    wav = 0.5 * np.sin(2 * np.pi * 220.0 * t)
+    f0 = yin_f0(wav, SR, HOP)
+    voiced = f0[f0 > 0]
+    assert len(voiced) > 0.9 * len(f0)
+    assert np.median(voiced) == pytest.approx(220.0, rel=0.02)
+    assert (yin_f0(np.zeros(SR), SR, HOP) == 0).all()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end corpus build on a synthetic mini-corpus
+# ---------------------------------------------------------------------------
+
+def _write_textgrid(path, phone_spans):
+    n = len(phone_spans)
+    xmax = phone_spans[-1][1]
+    body = [
+        'File type = "ooTextFile"',
+        'Object class = "TextGrid"',
+        "",
+        "xmin = 0",
+        f"xmax = {xmax}",
+        "tiers? <exists>",
+        "size = 1",
+        "item []:",
+        "    item [1]:",
+        '        class = "IntervalTier"',
+        '        name = "phones"',
+        "        xmin = 0",
+        f"        xmax = {xmax}",
+        f"        intervals: size = {n}",
+    ]
+    for i, (s, e, p) in enumerate(phone_spans, 1):
+        body += [
+            f"        intervals [{i}]:",
+            f"            xmin = {s}",
+            f"            xmax = {e}",
+            f'            text = "{p}"',
+        ]
+    with open(path, "w") as f:
+        f.write("\n".join(body) + "\n")
+
+
+def _make_corpus(root, n_utts=3):
+    import scipy.io.wavfile
+
+    raw = os.path.join(root, "raw")
+    out = os.path.join(root, "preprocessed")
+    spk = "S1"
+    os.makedirs(os.path.join(raw, spk))
+    os.makedirs(os.path.join(out, "TextGrid", spk))
+    rng = np.random.default_rng(0)
+    for i in range(n_utts):
+        dur = 1.2
+        t = np.arange(int(SR * dur)) / SR
+        hz = 160 + 40 * i
+        wav = 0.4 * np.sin(2 * np.pi * hz * t) + 0.01 * rng.standard_normal(len(t))
+        pcm = (wav * 32000).astype(np.int16)
+        scipy.io.wavfile.write(os.path.join(raw, spk, f"u{i}.wav"), SR, pcm)
+        with open(os.path.join(raw, spk, f"u{i}.lab"), "w") as f:
+            f.write(f"utterance {i}")
+        _write_textgrid(
+            os.path.join(out, "TextGrid", spk, f"u{i}.TextGrid"),
+            [
+                (0.0, 0.1, "sil"),
+                (0.1, 0.5, "HH"),
+                (0.5, 0.7, "AH0"),
+                (0.7, 1.0, "L"),
+                (1.0, dur, "sil"),
+            ],
+        )
+    return raw, out
+
+
+def test_preprocessor_end_to_end(tmp_path):
+    raw, out = _make_corpus(tmp_path)
+    cfg = Config(
+        preprocess=PreprocessConfig(
+            dataset="LJSpeech",
+            path=PathConfig(raw_path=raw, preprocessed_path=out),
+            preprocessing=PreprocessingConfig(val_size=1),
+        )
+    )
+    lines = Preprocessor(cfg).build_from_path(num_workers=1)
+    assert len(lines) == 3
+    base, speaker, text, raw_text = lines[0].split("|")
+    assert speaker == "S1" and text.startswith("{") and text.endswith("}")
+
+    stats = json.load(open(os.path.join(out, "stats.json")))
+    assert set(stats) == {"pitch", "energy"}
+    for k in ("pitch", "energy"):
+        vmin, vmax, mean, std = stats[k]
+        assert vmin < vmax and std > 0
+
+    speakers = json.load(open(os.path.join(out, "speakers.json")))
+    assert speakers == {"S1": 0}
+
+    train = open(os.path.join(out, "train.txt")).read().splitlines()
+    val = open(os.path.join(out, "val.txt")).read().splitlines()
+    assert len(train) == 2 and len(val) == 1
+
+    # features exist, shapes consistent: len(pitch) == len(duration) for
+    # phoneme-level; mel frames == sum(duration)
+    b = train[0].split("|")[0]
+    d = np.load(os.path.join(out, "duration", f"S1-duration-{b}.npy"))
+    p = np.load(os.path.join(out, "pitch", f"S1-pitch-{b}.npy"))
+    e = np.load(os.path.join(out, "energy", f"S1-energy-{b}.npy"))
+    m = np.load(os.path.join(out, "mel", f"S1-mel-{b}.npy"))
+    assert len(p) == len(d) == len(e) == 3  # HH, AH0, L
+    assert m.shape == (int(d.sum()), 80)
+    # normalized features: roughly zero-mean across corpus
+    assert abs(float(p.mean())) < 3.0
+
+
+def test_preprocessor_multiprocessing(tmp_path):
+    raw, out = _make_corpus(tmp_path)
+    cfg = Config(
+        preprocess=PreprocessConfig(
+            path=PathConfig(raw_path=raw, preprocessed_path=out),
+            preprocessing=PreprocessingConfig(val_size=1),
+        )
+    )
+    lines = Preprocessor(cfg).build_from_path(num_workers=2)
+    assert len(lines) == 3
+
+
+def test_preprocessor_trains_downstream(tmp_path):
+    """The preprocessor's output is directly consumable by SpeechDataset."""
+    from speakingstyle_tpu.data import BucketedBatcher, SpeechDataset
+
+    raw, out = _make_corpus(tmp_path)
+    cfg = Config(
+        preprocess=PreprocessConfig(
+            path=PathConfig(raw_path=raw, preprocessed_path=out),
+            preprocessing=PreprocessingConfig(val_size=1),
+        )
+    )
+    Preprocessor(cfg).build_from_path(num_workers=1)
+    ds = SpeechDataset("train.txt", cfg, sort=False, drop_last=False)
+    assert len(ds) == 2
+    batcher = BucketedBatcher(ds, max_src=64, max_mel=256)
+    batch = next(batcher.epoch(shuffle=False))
+    arrays = batch.arrays()
+    assert arrays["mels"].shape[-1] == 80
+    assert (arrays["durations"].sum(axis=1)[: batch.n_real]
+            == arrays["mel_lens"][: batch.n_real]).all()
+
+
+# ---------------------------------------------------------------------------
+# Corpus adapters
+# ---------------------------------------------------------------------------
+
+def test_ljspeech_prepare_align(tmp_path):
+    import scipy.io.wavfile
+
+    from speakingstyle_tpu.data.corpora import prepare_align
+
+    corpus = tmp_path / "LJSpeech-1.1"
+    (corpus / "wavs").mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    names = ["LJ001-0001", "LJ001-0002"]
+    for name in names:
+        wav = (rng.standard_normal(SR // 2) * 3000).astype(np.int16)
+        scipy.io.wavfile.write(corpus / "wavs" / f"{name}.wav", SR, wav)
+    (corpus / "metadata.csv").write_text(
+        "LJ001-0001|raw one|Printing, two words.\n"
+        "LJ001-0002|raw two|Number 42 here.\n"
+    )
+    raw = tmp_path / "raw"
+    cfg = Config(
+        preprocess=PreprocessConfig(
+            dataset="LJSpeech",
+            path=PathConfig(corpus_path=str(corpus), raw_path=str(raw)),
+        )
+    )
+    prepare_align(cfg)
+    for name in names:
+        assert (raw / "LJSpeech" / f"{name}.wav").exists()
+    lab = (raw / "LJSpeech" / "LJ001-0002.lab").read_text()
+    assert "forty" in lab and "42" not in lab  # cleaner expanded the number
+    sr, pcm = __import__("scipy.io.wavfile", fromlist=["read"]).read(
+        raw / "LJSpeech" / "LJ001-0001.wav"
+    )
+    assert sr == SR and pcm.dtype == np.int16
