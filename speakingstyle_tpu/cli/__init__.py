@@ -1,0 +1,49 @@
+"""Command-line entry points.
+
+Argument surface matches the reference scripts (reference: train.py:176-202,
+synthesize.py:153-292, preprocess.py, prepare_align.py, evaluate.py:91-122),
+plus ``--preset <DATASET>`` as a shorthand for the three YAML paths.
+
+Run as ``python -m speakingstyle_tpu <command> ...`` or via the installed
+``speakingstyle-tpu`` console script.
+"""
+
+import argparse
+
+from speakingstyle_tpu.configs.config import Config, load_config
+
+
+def add_config_args(parser: argparse.ArgumentParser, required: bool = False):
+    parser.add_argument(
+        "-p", "--preprocess_config", type=str, default=None,
+        help="path to preprocess.yaml",
+    )
+    parser.add_argument(
+        "-m", "--model_config", type=str, default=None, help="path to model.yaml"
+    )
+    parser.add_argument(
+        "-t", "--train_config", type=str, default=None, help="path to train.yaml"
+    )
+    parser.add_argument(
+        "--preset", type=str, default=None,
+        help="named preset (LJSpeech, LJSpeech_paper, LibriTTS, AISHELL3, "
+        "BC2013); explicit -p/-m/-t paths override individual files",
+    )
+    if required:
+        # mirror the reference's required -p/-m/-t while allowing --preset
+        parser.set_defaults(_config_required=True)
+
+
+def config_from_args(args) -> Config:
+    if getattr(args, "_config_required", False) and not (
+        args.preset or (args.preprocess_config and args.model_config and args.train_config)
+    ):
+        raise SystemExit(
+            "config required: pass --preset <DATASET> or all of -p/-m/-t"
+        )
+    return load_config(
+        preprocess=args.preprocess_config,
+        model=args.model_config,
+        train=args.train_config,
+        preset=args.preset,
+    )

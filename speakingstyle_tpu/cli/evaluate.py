@@ -1,0 +1,51 @@
+"""``evaluate`` command: full validation pass (reference: evaluate.py:91-122)."""
+
+import argparse
+
+from speakingstyle_tpu.cli import add_config_args, config_from_args
+
+
+def build_parser(parser=None):
+    parser = parser or argparse.ArgumentParser(description=__doc__)
+    add_config_args(parser, required=True)
+    parser.add_argument("--restore_step", type=int, default=-1)
+    return parser
+
+
+def main(args):
+    import jax
+
+    from speakingstyle_tpu.data import BucketedBatcher, SpeechDataset
+    from speakingstyle_tpu.data.prefetch import DevicePrefetcher
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.training.checkpoint import CheckpointManager
+    from speakingstyle_tpu.training.optim import make_optimizer
+    from speakingstyle_tpu.training.state import TrainState
+    from speakingstyle_tpu.training.trainer import evaluate, make_eval_step
+
+    cfg = config_from_args(args)
+    model = build_model(cfg)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(cfg.train.seed))
+    tx = make_optimizer(cfg.train)
+    state = TrainState.create(variables, tx)
+    ckpt = CheckpointManager(cfg.train.path.ckpt_path)
+    state = ckpt.restore(
+        state, step=args.restore_step if args.restore_step > 0 else None
+    )
+    eval_step = make_eval_step(model, cfg)
+
+    ds = SpeechDataset("val.txt", cfg, sort=False, drop_last=False)
+    batcher = BucketedBatcher(
+        ds, max_src=cfg.model.max_seq_len, max_mel=cfg.model.max_seq_len
+    )
+    losses = evaluate(
+        eval_step, state, DevicePrefetcher(batcher.epoch(shuffle=False))
+    )
+    msg = ", ".join(f"{k}: {v:.4f}" for k, v in losses.items())
+    print(f"Validation at step {int(state.step)}: {msg}")
+    ckpt.close()
+    return losses
+
+
+if __name__ == "__main__":
+    main(build_parser().parse_args())

@@ -1,0 +1,24 @@
+"""``prepare_align`` command: raw corpus -> MFA-ready tree
+(reference: prepare_align.py)."""
+
+import argparse
+
+from speakingstyle_tpu.cli import add_config_args, config_from_args
+
+
+def build_parser(parser=None):
+    parser = parser or argparse.ArgumentParser(description=__doc__)
+    add_config_args(parser, required=True)
+    parser.add_argument("--num_workers", type=int, default=None)
+    return parser
+
+
+def main(args):
+    from speakingstyle_tpu.data import corpora
+
+    cfg = config_from_args(args)
+    corpora.prepare_align(cfg, num_workers=args.num_workers)
+
+
+if __name__ == "__main__":
+    main(build_parser().parse_args())

@@ -1,0 +1,24 @@
+"""``preprocess`` command: TextGrids + wavs -> features
+(reference: preprocess.py — including the ctor-arity fix, SURVEY.md §2.5)."""
+
+import argparse
+
+from speakingstyle_tpu.cli import add_config_args, config_from_args
+
+
+def build_parser(parser=None):
+    parser = parser or argparse.ArgumentParser(description=__doc__)
+    add_config_args(parser, required=True)
+    parser.add_argument("--num_workers", type=int, default=None)
+    return parser
+
+
+def main(args):
+    from speakingstyle_tpu.data.preprocessor import Preprocessor
+
+    cfg = config_from_args(args)
+    Preprocessor(cfg).build_from_path(num_workers=args.num_workers)
+
+
+if __name__ == "__main__":
+    main(build_parser().parse_args())

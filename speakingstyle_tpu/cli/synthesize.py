@@ -1,0 +1,211 @@
+"""``synthesize`` command: batch and single-sentence controllable TTS.
+
+Reference: synthesize.py:153-292. Single mode requires ``--ref_audio`` (the
+style encoder always needs a reference mel); controls accept either a
+scalar for the whole utterance or — beyond the reference CLI, matching its
+notebooks' fine-control workflow (notebooks/control.ipynb) — a per-word
+list like ``--duration_control 1.0,2.5,1.0``.
+"""
+
+import argparse
+import json
+import os
+
+import numpy as np
+
+from speakingstyle_tpu.cli import add_config_args, config_from_args
+
+
+def build_parser(parser=None):
+    parser = parser or argparse.ArgumentParser(description=__doc__)
+    add_config_args(parser, required=True)
+    parser.add_argument("--restore_step", type=int, required=True)
+    parser.add_argument(
+        "--mode", type=str, choices=["batch", "single"], required=True,
+        help="synthesize a whole metadata file or a single sentence",
+    )
+    parser.add_argument(
+        "--source", type=str, default=None,
+        help="metadata file (train.txt/val.txt format), batch mode only",
+    )
+    parser.add_argument(
+        "--text", type=str, default=None,
+        help="raw text to synthesize, single mode only",
+    )
+    parser.add_argument(
+        "--ref_audio", type=str, default=None,
+        help="reference wav for the speaking style, single mode only (required)",
+    )
+    parser.add_argument("--speaker_id", type=int, default=0)
+    parser.add_argument(
+        "--pitch_control", type=str, default="1.0",
+        help="scalar, or comma-separated per-word factors",
+    )
+    parser.add_argument("--energy_control", type=str, default="1.0")
+    parser.add_argument(
+        "--duration_control", type=str, default="1.0",
+        help="scalar (larger = slower), or comma-separated per-word factors",
+    )
+    parser.add_argument(
+        "--vocoder_ckpt", type=str, default=None,
+        help="HiFi-GAN generator checkpoint (.pth.tar or .msgpack)",
+    )
+    parser.add_argument(
+        "--griffin_lim", action="store_true",
+        help="skip the neural vocoder; invert mels with Griffin-Lim",
+    )
+    parser.add_argument("--plot", action="store_true", help="also save mel plots")
+    return parser
+
+
+def _parse_control(spec: str):
+    """"1.0" -> scalar; "1.0,2.5,0.9" -> per-word list."""
+    parts = [float(x) for x in spec.split(",")]
+    return parts[0] if len(parts) == 1 else parts
+
+
+def _control_array(spec, spans, length):
+    """Scalar passes through; a per-word list becomes a [1, length] array."""
+    from speakingstyle_tpu.control import expand_word_controls, pad_control
+
+    if np.isscalar(spec):
+        return float(spec)
+    if spans is None:
+        raise SystemExit("per-word controls need single mode with English text")
+    return pad_control(expand_word_controls(spans, spec), length)
+
+
+def main(args):
+    import jax
+
+    from speakingstyle_tpu.audio.stft import MelExtractor, get_mel_from_wav
+    from speakingstyle_tpu.audio.tools import load_wav
+    from speakingstyle_tpu.data.dataset import Batch, TextBatcher, bucket_length
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.synthesis import get_vocoder, synth_samples
+    from speakingstyle_tpu.training.checkpoint import CheckpointManager
+    from speakingstyle_tpu.training.optim import make_optimizer
+    from speakingstyle_tpu.training.state import TrainState
+
+    if args.mode == "batch":
+        assert args.source is not None and args.text is None
+    else:
+        assert args.source is None and args.text is not None
+        if args.ref_audio is None:
+            raise SystemExit(
+                "--ref_audio is required in single mode: the style encoder "
+                "extracts gamma/beta from a reference mel"
+            )
+
+    cfg = config_from_args(args)
+    pp = cfg.preprocess.preprocessing
+    result_dir = os.path.join(cfg.train.path.result_path, str(args.restore_step))
+    os.makedirs(result_dir, exist_ok=True)
+
+    model = build_model(cfg)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(cfg.train.seed))
+    state = TrainState.create(variables, make_optimizer(cfg.train))
+    ckpt = CheckpointManager(cfg.train.path.ckpt_path)
+    state = ckpt.restore(
+        state,
+        step=args.restore_step if args.restore_step > 0 else None,
+        ignore_layers=cfg.train.ignore_layers,
+    )
+    ckpt.close()
+
+    vocoder = None if args.griffin_lim else get_vocoder(cfg, args.vocoder_ckpt)
+
+    p_c = _parse_control(args.pitch_control)
+    e_c = _parse_control(args.energy_control)
+    d_c = _parse_control(args.duration_control)
+
+    spans = None
+    if args.mode == "single":
+        from speakingstyle_tpu.control import english_word_spans, spans_to_sequence
+        from speakingstyle_tpu.text.g2p import preprocess_text, read_lexicon
+
+        lang = pp.text.language
+        lex_path = cfg.preprocess.path.lexicon_path or None
+        if lang == "en":
+            spans = english_word_spans(
+                args.text, read_lexicon(lex_path) if lex_path else {}
+            )
+            sequence = spans_to_sequence(spans, pp.text.text_cleaners)
+            print("Phoneme sequence:", " ".join(p for _, ps in spans for p in ps))
+        else:
+            sequence = preprocess_text(
+                args.text, lang, lex_path, list(pp.text.text_cleaners)
+            )
+
+        wav, _ = load_wav(args.ref_audio, target_sr=pp.audio.sampling_rate)
+        mel, _ = get_mel_from_wav(
+            wav,
+            MelExtractor(
+                pp.stft.filter_length, pp.stft.hop_length, pp.stft.win_length,
+                pp.mel.n_mel_channels, pp.audio.sampling_rate,
+                pp.mel.mel_fmin, pp.mel.mel_fmax,
+            ),
+        )
+        mel = mel.T  # [T, n_mels]
+
+        speakers_path = os.path.join(
+            cfg.preprocess.path.preprocessed_path, "speakers.json"
+        )
+        speaker = 0
+        if cfg.model.multi_speaker and os.path.exists(speakers_path):
+            with open(speakers_path) as f:
+                speaker_map = json.load(f)
+            # accept either a numeric id or a speaker name (the reference
+            # crashes on this lookup — synthesize.py:272, SURVEY.md §2.5)
+            key = str(args.speaker_id)
+            speaker = speaker_map.get(key, args.speaker_id)
+
+        L = bucket_length(len(sequence), 16)
+        T = bucket_length(mel.shape[0], 64)
+        texts = np.zeros((1, L), np.int32)
+        texts[0, : len(sequence)] = sequence
+        mels = np.zeros((1, T, mel.shape[1]), np.float32)
+        mels[0, : mel.shape[0]] = mel
+        import re as _re
+
+        safe_id = _re.sub(r"[^\w\-]+", "_", args.text[:100]).strip("_")[:60]
+        batches = [
+            Batch(
+                n_real=1,
+                ids=[safe_id or "utt"],
+                raw_texts=[args.text],
+                speakers=np.asarray([speaker], np.int32),
+                texts=texts,
+                src_lens=np.asarray([len(sequence)], np.int32),
+                mels=mels,
+                mel_lens=np.asarray([mel.shape[0]], np.int32),
+                pitches=np.zeros((1, L), np.float32),
+                energies=np.zeros((1, L), np.float32),
+                durations=np.zeros((1, L), np.int32),
+            )
+        ]
+    else:
+        batches = TextBatcher(args.source, cfg).epoch()
+
+    for batch in batches:
+        L = batch.texts.shape[1]
+        out = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            speakers=batch.speakers,
+            texts=batch.texts,
+            src_lens=batch.src_lens,
+            mels=batch.mels,
+            mel_lens=batch.mel_lens,
+            max_mel_len=int(cfg.model.max_seq_len),
+            p_control=_control_array(p_c, spans, L),
+            e_control=_control_array(e_c, spans, L),
+            d_control=_control_array(d_c, spans, L),
+            deterministic=True,
+        )
+        paths = synth_samples(batch, out, vocoder, cfg, result_dir, plot=args.plot)
+        for p in paths:
+            print("wrote", p)
+
+
+if __name__ == "__main__":
+    main(build_parser().parse_args())

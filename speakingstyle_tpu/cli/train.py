@@ -1,0 +1,65 @@
+"""``train`` command (reference: train.py:176-202)."""
+
+import argparse
+
+from speakingstyle_tpu.cli import add_config_args, config_from_args
+
+
+def build_parser(parser=None):
+    parser = parser or argparse.ArgumentParser(description=__doc__)
+    add_config_args(parser, required=True)
+    parser.add_argument(
+        "--restore_step", type=int, default=0,
+        help="checkpoint step to resume from (0 = fresh start; -1 = latest)",
+    )
+    parser.add_argument(
+        "--max_steps", type=int, default=None,
+        help="override total_step (smoke tests)",
+    )
+    parser.add_argument(
+        "--data_parallel", type=int, default=None,
+        help="data-axis size for the device mesh (default: all local devices)",
+    )
+    parser.add_argument(
+        "--synth", action="store_true",
+        help="render a GT-vs-predicted validation sample every synth_step",
+    )
+    parser.add_argument(
+        "--vocoder_ckpt", type=str, default=None,
+        help="HiFi-GAN checkpoint for --synth audio (Griffin-Lim otherwise)",
+    )
+    parser.add_argument(
+        "--profile_dir", type=str, default=None,
+        help="write a jax.profiler trace of steps 10-20 here",
+    )
+    return parser
+
+
+def main(args):
+    import jax
+
+    from speakingstyle_tpu.parallel.mesh import make_mesh
+    from speakingstyle_tpu.training.trainer import run_training
+
+    cfg = config_from_args(args)
+    n_dev = args.data_parallel or len(jax.devices())
+    mesh = make_mesh(data=n_dev, model=1) if n_dev > 1 else None
+    vocoder = None
+    if args.synth and args.vocoder_ckpt:
+        from speakingstyle_tpu.synthesis import get_vocoder
+
+        vocoder = get_vocoder(cfg, args.vocoder_ckpt)
+    state = run_training(
+        cfg,
+        mesh=mesh,
+        restore_step=args.restore_step if args.restore_step != 0 else None,
+        max_steps=args.max_steps,
+        synth_callback="default" if args.synth else None,
+        vocoder=vocoder,
+        profile_dir=args.profile_dir,
+    )
+    print(f"training finished at step {int(state.step)}")
+
+
+if __name__ == "__main__":
+    main(build_parser().parse_args())

@@ -1,0 +1,66 @@
+"""``train_vocoder`` command: HiFi-GAN GAN training
+(reference: hifigan/train.py:226-267 — with the discriminators the
+reference's vendored copy is missing)."""
+
+import argparse
+
+from speakingstyle_tpu.cli import add_config_args, config_from_args
+
+
+def build_parser(parser=None):
+    parser = parser or argparse.ArgumentParser(description=__doc__)
+    add_config_args(parser, required=True)
+    parser.add_argument(
+        "--input_wavs_dir", type=str, required=True,
+        help="directory tree of training wavs",
+    )
+    parser.add_argument("--checkpoint_path", type=str, default="./output/vocoder")
+    parser.add_argument("--training_steps", type=int, default=400000)
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument(
+        "--fine_tune_mel_dir", type=str, default=None,
+        help="acoustic-model mel dir: fine-tune on predicted mels",
+    )
+    parser.add_argument(
+        "--warm_start", type=str, default=None,
+        help="generator checkpoint (.pth.tar or .msgpack) to fine-tune from",
+    )
+    parser.add_argument("--data_parallel", type=int, default=None)
+    return parser
+
+
+def main(args):
+    import jax
+
+    from speakingstyle_tpu.data.mel_dataset import scan_wavs
+    from speakingstyle_tpu.parallel.mesh import make_mesh
+    from speakingstyle_tpu.training.vocoder_trainer import (
+        VocoderHParams,
+        train_vocoder,
+    )
+
+    cfg = config_from_args(args)
+    gen_params = None
+    if args.warm_start:
+        from speakingstyle_tpu.synthesis import get_vocoder
+
+        _, gen_params = get_vocoder(cfg, args.warm_start)
+    n_dev = args.data_parallel or len(jax.devices())
+    mesh = make_mesh(data=n_dev, model=1) if n_dev > 1 else None
+    wavs = scan_wavs(args.input_wavs_dir)
+    print(f"training vocoder on {len(wavs)} wavs")
+    train_vocoder(
+        cfg,
+        wavs,
+        hp=VocoderHParams(),
+        max_steps=args.training_steps,
+        batch_size=args.batch_size,
+        mesh=mesh,
+        ckpt_path=args.checkpoint_path,
+        fine_tune_mel_dir=args.fine_tune_mel_dir,
+        gen_params=gen_params,
+    )
+
+
+if __name__ == "__main__":
+    main(build_parser().parse_args())

@@ -22,9 +22,9 @@ _ADAPTERS: Dict[str, Callable[[Config], None]] = {
 }
 
 
-def prepare_align(config: Config) -> None:
+def prepare_align(config: Config, num_workers=None) -> None:
     """Dispatch on ``preprocess.dataset`` (reference: prepare_align.py:8-26)."""
     name = config.preprocess.dataset
     if name not in _ADAPTERS:
         raise ValueError(f"unknown dataset {name!r}; known: {sorted(_ADAPTERS)}")
-    _ADAPTERS[name](config)
+    _ADAPTERS[name](config, num_workers=num_workers)

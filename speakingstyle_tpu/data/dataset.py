@@ -267,3 +267,45 @@ class TextBatcher:
                 mel = np.load(path).astype(np.float32)
         item["mel"] = mel
         return item
+
+    def epoch(
+        self,
+        batch_size: int = 8,
+        src_bucket: int = 32,
+        mel_bucket: int = 128,
+    ) -> Iterator[Batch]:
+        """Padded inference batches (reference: synthesize.py:255-262 uses a
+        bs-8 DataLoader). Target arrays are zeros — free-running mode only
+        reads texts + the style-reference mel."""
+        for s in range(0, len(self), batch_size):
+            items = [self[i] for i in range(s, min(s + batch_size, len(self)))]
+            B = len(items)
+            for d in items:
+                if d["mel"] is None:
+                    raise ValueError(
+                        f"no reference mel for {d['id']!r}: the style encoder "
+                        "requires one (reference: synthesize.py --ref_audio)"
+                    )
+            src_lens = np.asarray([len(d["text"]) for d in items], np.int32)
+            mel_lens = np.asarray([d["mel"].shape[0] for d in items], np.int32)
+            L_src = bucket_length(int(src_lens.max()), src_bucket)
+            L_mel = bucket_length(int(mel_lens.max()), mel_bucket)
+            n_mels = items[0]["mel"].shape[1]
+            texts = np.zeros((B, L_src), np.int32)
+            mels = np.zeros((B, L_mel, n_mels), np.float32)
+            for i, d in enumerate(items):
+                texts[i, : src_lens[i]] = d["text"]
+                mels[i, : mel_lens[i]] = d["mel"]
+            yield Batch(
+                n_real=B,
+                ids=[d["id"] for d in items],
+                raw_texts=[d["raw_text"] for d in items],
+                speakers=np.asarray([d["speaker"] for d in items], np.int32),
+                texts=texts,
+                src_lens=src_lens,
+                mels=mels,
+                mel_lens=mel_lens,
+                pitches=np.zeros((B, L_src), np.float32),
+                energies=np.zeros((B, L_src), np.float32),
+                durations=np.zeros((B, L_src), np.int32),
+            )

@@ -1,0 +1,119 @@
+"""Vocoder training data: random wav segments + on-the-fly mel.
+
+Reference: hifigan/meldataset.py:48-167 — random fixed-size segment crops
+(8192 samples = 32 hops), mel computed per segment; fine-tune mode loads
+the acoustic model's predicted mels and crops wav/mel in lockstep.
+
+The mel here is computed with the framework's own numpy STFT path (exactly
+the constants the preprocessor used), so the vocoder trains against the
+same features the acoustic model predicts — the reference instead had two
+subtly different mel implementations (audio/stft.py vs hifigan/meldataset.py).
+"""
+
+import os
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from speakingstyle_tpu.audio.mel import mel_filterbank
+from speakingstyle_tpu.audio.stft import hann_window
+from speakingstyle_tpu.audio.tools import load_wav
+from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.data.preprocessor import _numpy_mel_energy
+
+
+def scan_wavs(root: str) -> List[str]:
+    out = []
+    for dirpath, _, names in os.walk(root):
+        out += [os.path.join(dirpath, n) for n in names if n.endswith(".wav")]
+    return sorted(out)
+
+
+class MelWavDataset:
+    """Yields (wav_segment [B, S], mel [B, S/hop, n_mels]) batches."""
+
+    def __init__(
+        self,
+        wav_paths: List[str],
+        config: Config,
+        segment_size: int = 8192,
+        batch_size: int = 16,
+        fine_tune_mel_dir: Optional[str] = None,
+        seed: int = 1234,
+    ):
+        pp = config.preprocess.preprocessing
+        if segment_size % pp.stft.hop_length != 0:
+            raise ValueError(
+                f"segment_size {segment_size} must be a multiple of "
+                f"hop_length {pp.stft.hop_length}"
+            )
+        self.paths = list(wav_paths)
+        if len(self.paths) < batch_size:
+            raise ValueError(
+                f"{len(self.paths)} wavs < batch_size {batch_size}: epoch() "
+                "would yield no batches (lower --batch_size or add data)"
+            )
+        self.segment = segment_size
+        self.batch_size = batch_size
+        self.sr = pp.audio.sampling_rate
+        self.hop = pp.stft.hop_length
+        self.n_fft = pp.stft.filter_length
+        self.fine_tune_mel_dir = fine_tune_mel_dir
+        self._mel_index = {}
+        if fine_tune_mel_dir is not None:
+            # exact-basename index: "<speaker>-mel-<base>.npy" or "<base>.npy"
+            for name in os.listdir(fine_tune_mel_dir):
+                if not name.endswith(".npy"):
+                    continue
+                stem = name[: -len(".npy")]
+                base = stem.split("-mel-", 1)[1] if "-mel-" in stem else stem
+                self._mel_index[base] = os.path.join(fine_tune_mel_dir, name)
+        self.rng = np.random.default_rng(seed)
+        self._mel_basis = mel_filterbank(
+            self.sr, self.n_fft, pp.mel.n_mel_channels, pp.mel.mel_fmin,
+            pp.mel.mel_fmax,
+        )
+        self._window = hann_window(pp.stft.win_length, self.n_fft)
+
+    def _load_item(self, path: str) -> Tuple[np.ndarray, np.ndarray]:
+        wav, _ = load_wav(path, target_sr=self.sr)
+        S = self.segment
+        if self.fine_tune_mel_dir is not None:
+            base = os.path.splitext(os.path.basename(path))[0]
+            if base not in self._mel_index:
+                raise FileNotFoundError(f"no fine-tune mel for {base!r}")
+            mel = np.load(self._mel_index[base])
+            # crop wav/mel in lockstep (reference: meldataset.py:121-138)
+            frames = S // self.hop
+            if mel.shape[0] > frames:
+                start = int(self.rng.integers(0, mel.shape[0] - frames + 1))
+                mel = mel[start : start + frames]
+                wav = wav[start * self.hop : start * self.hop + S]
+            wav = np.pad(wav, (0, max(0, S - len(wav))))
+            mel = np.pad(mel, ((0, frames - mel.shape[0]), (0, 0)))
+            return wav[:S], mel
+        if len(wav) >= S:
+            start = int(self.rng.integers(0, len(wav) - S + 1))
+            wav = wav[start : start + S]
+        else:
+            wav = np.pad(wav, (0, S - len(wav)))
+        mel, _ = _numpy_mel_energy(
+            wav, self._mel_basis, self._window, self.n_fft, self.hop
+        )
+        return wav, mel[: S // self.hop]
+
+    def epoch(self, shuffle: bool = True) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        order = np.arange(len(self.paths))
+        if shuffle:
+            self.rng.shuffle(order)
+        for s in range(0, len(order) - self.batch_size + 1, self.batch_size):
+            wavs, mels = [], []
+            for i in order[s : s + self.batch_size]:
+                w, m = self._load_item(self.paths[int(i)])
+                wavs.append(w)
+                mels.append(m)
+            yield np.stack(wavs).astype(np.float32), np.stack(mels).astype(np.float32)
+
+    def __iter__(self):
+        while True:
+            yield from self.epoch()

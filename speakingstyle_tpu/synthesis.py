@@ -1,0 +1,256 @@
+"""Synthesis utilities: vocoder loading, sample rendering, mel plots.
+
+Reference: utils/model.py:62-115 (get_vocoder / vocoder_infer) and
+utils/tools.py:128-282 (synth_one_sample / synth_samples / plot_mel).
+Outputs are dict-keyed (this framework's model returns a dict, not a
+12-tuple) but the rendered artifacts — wav files scaled by max_wav_value,
+mel plots with pitch/energy overlays in de-normalized units — match the
+reference's.
+"""
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from speakingstyle_tpu.audio.tools import griffin_lim, save_wav
+from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.models.hifigan import (
+    Generator,
+    generator_from_config,
+    vocoder_infer,
+)
+
+# The pretrained LJSpeech/universal generators' hyperparameters
+# (reference: hifigan/config.json — 22050 Hz, hop 256, 80 mels).
+DEFAULT_HIFIGAN_CONFIG = {
+    "resblock": "1",
+    "upsample_rates": [8, 8, 2, 2],
+    "upsample_kernel_sizes": [16, 16, 4, 4],
+    "upsample_initial_channel": 512,
+    "resblock_kernel_sizes": [3, 7, 11],
+    "resblock_dilation_sizes": [[1, 3, 5], [1, 3, 5], [1, 3, 5]],
+}
+
+
+def get_vocoder(
+    cfg: Config,
+    ckpt_path: Optional[str] = None,
+    config_path: Optional[str] = None,
+    rng=None,
+) -> Tuple[Generator, Dict]:
+    """Build the HiFi-GAN generator and load weights.
+
+    ``ckpt_path`` may be a PyTorch ``generator_*.pth.tar`` (converted via
+    compat/torch_convert, weight norm folded) or an Orbax/msgpack params
+    file from this framework's vocoder trainer. Without a checkpoint the
+    generator is randomly initialized (tests / Griffin-Lim comparison).
+    Reference: utils/model.py:62-94.
+    """
+    name = cfg.model.vocoder.model
+    if name not in ("HiFi-GAN", "hifigan"):
+        raise NotImplementedError(
+            f"vocoder {name!r}: only HiFi-GAN is supported on TPU "
+            "(the reference's MelGAN path pulls torch.hub weights); "
+            "use synthesize --griffin_lim for a vocoder-free fallback"
+        )
+    hcfg = dict(DEFAULT_HIFIGAN_CONFIG)
+    if config_path:
+        with open(config_path) as f:
+            hcfg.update(json.load(f))
+    gen = generator_from_config(hcfg)
+
+    if ckpt_path and ckpt_path.endswith(".msgpack"):
+        from flax import serialization
+
+        import jax
+
+        n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+        init = gen.init(
+            jax.random.PRNGKey(0), np.zeros((1, 16, n_mels), np.float32)
+        )["params"]
+        with open(ckpt_path, "rb") as f:
+            params = serialization.from_bytes(init, f.read())
+    elif ckpt_path:
+        from speakingstyle_tpu.compat.torch_convert import (
+            convert_hifigan,
+            fold_weight_norm,
+            load_torch_state_dict,
+        )
+
+        sd = load_torch_state_dict(ckpt_path, key="generator")
+        params = convert_hifigan(fold_weight_norm(sd))
+    else:
+        import jax
+
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+        params = gen.init(rng, np.zeros((1, 16, n_mels), np.float32))["params"]
+    return gen, params
+
+
+def expand(values: np.ndarray, durations: np.ndarray) -> np.ndarray:
+    """Phoneme-level series -> frame-level by repeating each value
+    duration[i] times (reference: utils/tools.py:118-125)."""
+    return np.repeat(
+        np.asarray(values), np.asarray(durations, np.int64)
+    )
+
+
+def _frame_level_overlay(batch_arr, lens, durations, level: str):
+    """Pick the [: len] slice and expand phoneme-level series to frames."""
+    if level == "phoneme_level":
+        return expand(batch_arr, durations)
+    return np.asarray(batch_arr)[: int(lens)]
+
+
+def load_denorm_stats(cfg: Config) -> List[float]:
+    """stats.json -> [p_min, p_max, p_mean, p_std, e_min, e_max]
+    (reference: utils/tools.py:147-151)."""
+    path = os.path.join(cfg.preprocess.path.preprocessed_path, "stats.json")
+    if os.path.exists(path):
+        with open(path) as f:
+            stats = json.load(f)
+        return list(stats["pitch"]) + list(stats["energy"][:2])
+    return [-3.0, 12.0, 0.0, 1.0, -2.0, 10.0]
+
+
+def plot_mel(data, stats, titles=None):
+    """Stacked mel panels with F0 (left axis) and energy (right axis)
+    overlays in de-normalized units (reference: utils/tools.py:233-282)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    fig, axes = plt.subplots(len(data), 1, squeeze=False)
+    titles = titles or [None] * len(data)
+    p_min, p_max, p_mean, p_std, e_min, e_max = stats
+    p_min, p_max = p_min * p_std + p_mean, p_max * p_std + p_mean
+
+    for i, (mel, pitch, energy) in enumerate(data):
+        ax = axes[i][0]
+        pitch = np.asarray(pitch) * p_std + p_mean
+        ax.imshow(mel, origin="lower")
+        ax.set_aspect(2.5, adjustable="box")
+        ax.set_ylim(0, mel.shape[0])
+        ax.set_title(titles[i], fontsize="medium")
+        ax.tick_params(labelsize="x-small", left=False, labelleft=False)
+        ax.set_anchor("W")
+
+        ax1 = fig.add_axes(ax.get_position(), anchor="W")
+        ax1.set_facecolor("None")
+        ax1.plot(pitch, color="tomato")
+        ax1.set_xlim(0, mel.shape[1])
+        ax1.set_ylim(0, p_max)
+        ax1.set_ylabel("F0", color="tomato")
+        ax1.tick_params(labelsize="x-small", colors="tomato",
+                        bottom=False, labelbottom=False)
+
+        ax2 = fig.add_axes(ax.get_position(), anchor="W")
+        ax2.set_facecolor("None")
+        ax2.plot(np.asarray(energy), color="darkviolet")
+        ax2.set_xlim(0, mel.shape[1])
+        ax2.set_ylim(e_min, e_max)
+        ax2.set_ylabel("Energy", color="darkviolet")
+        ax2.yaxis.set_label_position("right")
+        ax2.tick_params(labelsize="x-small", colors="darkviolet",
+                        bottom=False, labelbottom=False, left=False,
+                        labelleft=False, right=True, labelright=True)
+    return fig
+
+
+def _vocode(cfg: Config, vocoder, mels, lengths=None):
+    """mels [B, T, n_mels] (normalized log-mel) -> list of int16 wavs."""
+    max_wav = cfg.preprocess.preprocessing.audio.max_wav_value
+    if vocoder is not None:
+        gen, params = vocoder
+        return vocoder_infer(gen, params, mels, lengths=lengths, max_wav_value=max_wav)
+    # Griffin-Lim fallback: invert log-mel via filterbank pseudo-inverse
+    from speakingstyle_tpu.audio.mel import mel_filterbank
+
+    pp = cfg.preprocess.preprocessing
+    fb = mel_filterbank(pp.audio.sampling_rate, pp.stft.filter_length,
+                        pp.mel.n_mel_channels, pp.mel.mel_fmin, pp.mel.mel_fmax)
+    inv = np.linalg.pinv(fb)
+    out = []
+    for i in range(mels.shape[0]):
+        T = int(lengths[i]) if lengths is not None else mels.shape[1]
+        mag = np.maximum(inv @ np.exp(np.asarray(mels[i, :T])).T, 1e-8)
+        wav = np.asarray(
+            griffin_lim(mag[None], pp.stft.filter_length, pp.stft.hop_length,
+                        pp.stft.win_length)
+        )[0]
+        out.append((np.clip(wav, -1, 1) * (max_wav - 1)).astype(np.int16))
+    return out
+
+
+def synth_one_sample(batch, output, vocoder, cfg: Config):
+    """First batch item: (fig, wav_reconstruction, wav_prediction, basename)
+    for validation logging (reference: utils/tools.py:128-180)."""
+    pp = cfg.preprocess.preprocessing
+    mel_len = int(np.asarray(output["mel_lens"])[0])
+    src_len = int(np.asarray(batch.src_lens)[0])
+    durations = np.asarray(batch.durations)[0, :src_len]
+    mel_target = np.asarray(batch.mels)[0, :mel_len]
+    mel_pred = np.asarray(output["mel_postnet"])[0, :mel_len]
+
+    pitch = _frame_level_overlay(
+        np.asarray(batch.pitches)[0, :src_len] if pp.pitch.feature == "phoneme_level"
+        else np.asarray(batch.pitches)[0], mel_len, durations, pp.pitch.feature)
+    energy = _frame_level_overlay(
+        np.asarray(batch.energies)[0, :src_len] if pp.energy.feature == "phoneme_level"
+        else np.asarray(batch.energies)[0], mel_len, durations, pp.energy.feature)
+
+    fig = plot_mel(
+        [(mel_pred.T, pitch, energy), (mel_target.T, pitch, energy)],
+        load_denorm_stats(cfg),
+        ["Synthetized Spectrogram", "Ground-Truth Spectrogram"],
+    )
+    wav_recon = _vocode(cfg, vocoder, mel_target[None], [mel_len])[0]
+    wav_pred = _vocode(cfg, vocoder, mel_pred[None], [mel_len])[0]
+    return fig, wav_recon, wav_pred, batch.ids[0]
+
+
+def synth_samples(batch, output, vocoder, cfg: Config, path: str, plot: bool = False):
+    """Write one wav (and optionally one plot) per batch item
+    (reference: utils/tools.py:183-230). Only ``batch.n_real`` items are
+    rendered — padded dummy rows are skipped."""
+    os.makedirs(path, exist_ok=True)
+    pp = cfg.preprocess.preprocessing
+    mel_lens = np.asarray(output["mel_lens"])
+    stats = load_denorm_stats(cfg)
+
+    n = getattr(batch, "n_real", len(batch.ids))
+    if plot:
+        src_lens = np.asarray(batch.src_lens)
+        durations = np.asarray(output["durations"])
+        for i in range(n):
+            mel_len, src_len = int(mel_lens[i]), int(src_lens[i])
+            dur = durations[i, :src_len]
+            mel_pred = np.asarray(output["mel_postnet"])[i, :mel_len]
+            pitch = _frame_level_overlay(
+                np.asarray(output["pitch_prediction"])[i, :src_len]
+                if pp.pitch.feature == "phoneme_level"
+                else np.asarray(output["pitch_prediction"])[i],
+                mel_len, dur, pp.pitch.feature)
+            energy = _frame_level_overlay(
+                np.asarray(output["energy_prediction"])[i, :src_len]
+                if pp.energy.feature == "phoneme_level"
+                else np.asarray(output["energy_prediction"])[i],
+                mel_len, dur, pp.energy.feature)
+            fig = plot_mel([(mel_pred.T, pitch, energy)], stats,
+                           ["Synthetized Spectrogram"])
+            fig.savefig(os.path.join(path, f"{batch.ids[i]}.png"))
+            import matplotlib.pyplot as plt
+
+            plt.close(fig)
+
+    wavs = _vocode(cfg, vocoder, np.asarray(output["mel_postnet"])[:n], mel_lens[:n])
+    sr = pp.audio.sampling_rate
+    for wav, basename in zip(wavs, batch.ids[:n]):
+        import scipy.io.wavfile
+
+        scipy.io.wavfile.write(os.path.join(path, f"{basename}.wav"), sr, wav)
+    return [os.path.join(path, f"{b}.wav") for b in batch.ids[:n]]

@@ -177,12 +177,19 @@ def run_training(
     max_steps: Optional[int] = None,
     synth_callback=None,
     log: bool = True,
+    vocoder=None,
+    profile_dir: Optional[str] = None,
+    profile_steps: tuple = (10, 20),
 ):
     """The full training loop (reference: train.py:21-173).
 
     Returns the final TrainState. `max_steps` overrides total_step (tests);
-    `synth_callback(state, batch, arrays, step)` runs every synth_step.
+    `synth_callback(state, batch, arrays, step, model)` runs every
+    synth_step — pass "default" for the GT-vs-predicted sample renderer.
+    `profile_dir` enables a jax.profiler trace over the step window
+    ``profile_steps`` (greenfield vs the reference — SURVEY.md §5).
     """
+    import time
     import jax.numpy as jnp
 
     from speakingstyle_tpu.data import (
@@ -240,21 +247,46 @@ def run_training(
     )
 
     logger = TrainLogger(cfg.train.path.log_path) if log else None
+    if synth_callback == "default":
+        synth_callback = default_synth_callback(cfg, logger, vocoder=vocoder)
     step_rng = jax.random.PRNGKey(cfg.train.seed + 1)
 
     step = int(state.step)
+    window_t0, window_step0, window_frames = time.perf_counter(), step, 0
+    trace_active = False
     try:
         for batch, arrays in prefetch:
             if step >= total_step:
                 break
+            if (
+                profile_dir is not None
+                and not trace_active
+                and profile_steps[0] <= step < profile_steps[1]
+            ):
+                jax.profiler.start_trace(profile_dir)
+                trace_active = True
             state, losses = train_step(state, arrays, step_rng)
             step += 1
+            window_frames += int(batch.mel_lens.sum())  # host-side, no sync
+            if trace_active and step >= profile_steps[1]:
+                jax.block_until_ready(losses["total_loss"])
+                jax.profiler.stop_trace()
+                trace_active = False
 
             if logger and step % steps.log_step == 0:
+                jax.block_until_ready(losses["total_loss"])
                 lr = float(schedule(jnp.asarray(step - 1)))
                 logger.log(step, {k: float(v) for k, v in losses.items()}, lr=lr)
+                dt = time.perf_counter() - window_t0
+                if dt > 0 and step > window_step0:
+                    logger.log_throughput(
+                        step, (step - window_step0) / dt, window_frames / dt
+                    )
+                window_t0, window_step0, window_frames = (
+                    time.perf_counter(), step, 0,
+                )
             if synth_callback is not None and step % steps.synth_step == 0:
-                synth_callback(state, batch, arrays, step)
+                synth_callback(state, batch, arrays, step, model)
             if step % steps.val_step == 0:
                 val_losses = evaluate(
                     eval_step,
@@ -266,6 +298,8 @@ def run_training(
             if step % steps.save_step == 0:
                 ckpt.save(step, jax.device_get(state))
     finally:
+        if trace_active:
+            jax.profiler.stop_trace()  # run ended inside the profile window
         prefetch.stop()
         if logger:
             logger.close()
@@ -274,8 +308,9 @@ def run_training(
 
 
 class TrainLogger:
-    """TensorBoard scalars + append-only log.txt (reference: train.py:53-61,
-    utils/tools.py:82-107). tensorboardX is optional; text log always works."""
+    """TensorBoard scalars/figures/audio + append-only log.txt (reference:
+    train.py:53-61, utils/tools.py:82-107). tensorboardX is optional; the
+    text log always works."""
 
     def __init__(self, log_dir: str, use_tensorboard: bool = True):
         os.makedirs(log_dir, exist_ok=True)
@@ -303,7 +338,64 @@ class TrainLogger:
             if lr is not None:
                 self.tb.add_scalar(f"{prefix}/lr", lr, step)
 
+    def log_throughput(self, step: int, steps_per_sec: float, frames_per_sec: float):
+        self.txt.write(
+            f"[perf] Step {step}, steps/s: {steps_per_sec:.2f}, "
+            f"mel-frames/s: {frames_per_sec:.0f}\n"
+        )
+        self.txt.flush()
+        if self.tb is not None:
+            self.tb.add_scalar("perf/steps_per_sec", steps_per_sec, step)
+            self.tb.add_scalar("perf/mel_frames_per_sec", frames_per_sec, step)
+
+    def log_figure(self, step: int, tag: str, fig):
+        if self.tb is not None:
+            self.tb.add_figure(tag, fig, step)
+
+    def log_audio(self, step: int, tag: str, wav, sampling_rate: int,
+                  max_wav_value: float = 32768.0):
+        if self.tb is not None:
+            import numpy as np
+
+            wav = np.asarray(wav, np.float32) / max_wav_value
+            try:
+                self.tb.add_audio(tag, wav[None], step, sample_rate=sampling_rate)
+            except ModuleNotFoundError:
+                pass  # tensorboardX audio needs soundfile; scalars/figures still log
+
     def close(self):
         self.txt.close()
         if self.tb is not None:
             self.tb.close()
+
+
+def default_synth_callback(cfg: Config, logger: Optional[TrainLogger], vocoder=None):
+    """Periodic validation-sample rendering (reference: train.py:117-144):
+    plot GT-vs-predicted mel and log both vocoded wavs to TensorBoard."""
+
+    def callback(state, batch, arrays, step, model):
+        from speakingstyle_tpu.synthesis import synth_one_sample
+
+        out = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            **_model_kwargs(arrays, teacher_forced=True),
+            deterministic=True,
+        )
+        fig, wav_recon, wav_pred, basename = synth_one_sample(
+            batch, out, vocoder, cfg
+        )
+        if logger is not None:
+            sr = cfg.preprocess.preprocessing.audio.sampling_rate
+            mw = cfg.preprocess.preprocessing.audio.max_wav_value
+            logger.log_figure(step, f"Training/{basename}", fig)
+            logger.log_audio(
+                step, f"Training/{basename}_reconstructed", wav_recon, sr, mw
+            )
+            logger.log_audio(
+                step, f"Training/{basename}_synthesized", wav_pred, sr, mw
+            )
+        import matplotlib.pyplot as plt
+
+        plt.close(fig)
+
+    return callback

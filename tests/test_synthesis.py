@@ -1,0 +1,324 @@
+"""G2P frontend, per-word control, synthesis utils, and CLI surface."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from speakingstyle_tpu.configs.config import (
+    Config,
+    ModelConfig,
+    PathConfig,
+    PreprocessConfig,
+    ReferenceEncoderConfig,
+    TrainConfig,
+    TrainPathConfig,
+    TransformerConfig,
+    VarianceEmbeddingConfig,
+    VariancePredictorConfig,
+)
+from speakingstyle_tpu.control import (
+    english_word_spans,
+    expand_word_controls,
+    pad_control,
+    spans_to_sequence,
+)
+from speakingstyle_tpu.text.g2p import (
+    english_to_phones,
+    mandarin_to_phones,
+    preprocess_text,
+    read_lexicon,
+)
+
+LEXICON = {"hello": ["HH", "AH0", "L", "OW1"], "world": ["W", "ER1", "L", "D"]}
+
+
+def tiny_config(**kw):
+    return Config(
+        model=ModelConfig(
+            transformer=TransformerConfig(
+                encoder_layer=1, decoder_layer=1, encoder_hidden=32,
+                decoder_hidden=32, conv_filter_size=64,
+            ),
+            reference_encoder=ReferenceEncoderConfig(
+                encoder_layer=1, encoder_hidden=32, conv_filter_size=64,
+            ),
+            variance_predictor=VariancePredictorConfig(filter_size=32),
+            variance_embedding=VarianceEmbeddingConfig(n_bins=16),
+            max_seq_len=96,
+        ),
+        **kw,
+    )
+
+
+# ---------------------------------------------------------------------------
+# G2P frontend
+# ---------------------------------------------------------------------------
+
+def test_read_lexicon(tmp_path):
+    p = tmp_path / "lex.txt"
+    p.write_text("HELLO HH AH0 L OW1\nhello X X\nWORLD  W ER1 L D\n")
+    lex = read_lexicon(str(p))
+    assert lex["hello"] == ["HH", "AH0", "L", "OW1"]  # first entry wins
+    assert lex["world"] == ["W", "ER1", "L", "D"]
+
+
+def test_english_to_phones_lexicon_hits():
+    s = english_to_phones("Hello world", LEXICON, g2p=None)
+    assert s == "{HH AH0 L OW1 W ER1 L D}"
+
+
+def test_english_to_phones_punct_and_oov():
+    s = english_to_phones("hello, zzqj world!", LEXICON, g2p=None)
+    # comma -> sp, OOV without g2p -> spn, trailing ! stripped
+    assert s == "{HH AH0 L OW1 sp spn W ER1 L D}"
+
+
+def test_mandarin_to_phones_lexicon():
+    lex = {"ni3": ["n", "i3"], "hao3": ["h", "ao3"]}
+    s = mandarin_to_phones("ni3 hao3 oov", lex)
+    assert s == "{n i3 h ao3 sp}"
+
+
+def test_preprocess_text_sequence(tmp_path):
+    p = tmp_path / "lex.txt"
+    p.write_text("HELLO HH AH0 L OW1\n")
+    seq = preprocess_text("hello", "en", str(p), ["english_cleaners"])
+    assert seq.dtype == np.int32 and len(seq) == 4
+
+
+# ---------------------------------------------------------------------------
+# Per-word fine-grained control
+# ---------------------------------------------------------------------------
+
+def test_english_word_spans_and_sequence():
+    spans = english_word_spans("Hello world", LEXICON, g2p=None)
+    assert [w for w, _ in spans] == ["Hello", "world"]
+    assert [len(ps) for _, ps in spans] == [4, 4]
+    seq = spans_to_sequence(spans, ["english_cleaners"])
+    assert len(seq) == 8
+
+
+def test_expand_word_controls_variants():
+    spans = [("a", ["X", "Y"]), ("b", ["Z"])]
+    np.testing.assert_allclose(expand_word_controls(spans, 2.0), [2, 2, 2])
+    np.testing.assert_allclose(expand_word_controls(spans, [1.0, 3.0]), [1, 1, 3])
+    np.testing.assert_allclose(
+        expand_word_controls(spans, {1: 2.5}), [1, 1, 2.5]
+    )
+    with pytest.raises(ValueError):
+        expand_word_controls(spans, [1.0])
+
+
+def test_pad_control():
+    out = pad_control(np.asarray([2.0, 3.0], np.float32), 5)
+    np.testing.assert_allclose(out, [[2, 3, 1, 1, 1]])
+
+
+def test_per_phone_duration_control_changes_length():
+    """A [B, L] duration-control array must flow through the jitted forward
+    and scale predicted durations per phone."""
+    import jax
+
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+
+    cfg = tiny_config()
+    model = build_model(cfg)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    B, L, T = 1, 6, 48
+    rng = np.random.default_rng(0)
+    kw = dict(
+        speakers=np.zeros((B,), np.int32),
+        texts=rng.integers(1, 300, (B, L)).astype(np.int32),
+        src_lens=np.full((B,), L, np.int32),
+        mels=rng.standard_normal((B, T, 80)).astype(np.float32),
+        mel_lens=np.full((B,), T, np.int32),
+        max_mel_len=T,
+        deterministic=True,
+    )
+    apply = lambda **c: model.apply(
+        {"params": variables["params"],
+         "batch_stats": variables.get("batch_stats", {})}, **kw, **c)
+    base = apply()
+    uniform = apply(d_control=2.0)
+    per_phone = apply(d_control=np.full((B, L), 2.0, np.float32))
+    # scalar 2.0 and all-2.0 per-phone array must agree exactly
+    np.testing.assert_array_equal(
+        np.asarray(uniform["durations"]), np.asarray(per_phone["durations"])
+    )
+    # uneven per-phone control shifts duration mass to the scaled phone
+    half = np.ones((B, L), np.float32)
+    half[:, 0] = 3.0
+    uneven = apply(d_control=half)
+    d_base = np.asarray(base["durations"])
+    d_uneven = np.asarray(uneven["durations"])
+    np.testing.assert_array_equal(d_uneven[:, 1:], d_base[:, 1:])
+    assert (d_uneven[:, 0] >= d_base[:, 0]).all()
+
+
+# ---------------------------------------------------------------------------
+# Synthesis utils
+# ---------------------------------------------------------------------------
+
+def test_expand():
+    from speakingstyle_tpu.synthesis import expand
+
+    np.testing.assert_allclose(
+        expand(np.asarray([1.0, 2.0, 3.0]), np.asarray([2, 0, 3])),
+        [1, 1, 3, 3, 3],
+    )
+
+
+def test_plot_mel_smoke():
+    from speakingstyle_tpu.synthesis import plot_mel
+
+    rng = np.random.default_rng(0)
+    fig = plot_mel(
+        [(rng.standard_normal((80, 50)), rng.standard_normal(50),
+          rng.standard_normal(50))],
+        [-2.0, 9.0, 150.0, 40.0, -1.5, 8.0],
+        ["test"],
+    )
+    assert fig is not None
+    import matplotlib.pyplot as plt
+
+    plt.close(fig)
+
+
+def test_get_vocoder_random_init_and_infer():
+    from speakingstyle_tpu.synthesis import get_vocoder
+    from speakingstyle_tpu.models.hifigan import vocoder_infer
+
+    cfg = tiny_config()
+    gen, params = get_vocoder(cfg, ckpt_path=None)
+    mels = np.zeros((2, 16, 80), np.float32)
+    wavs = vocoder_infer(gen, params, mels, lengths=[10, 16])
+    assert wavs[0].shape == (10 * 256,) and wavs[1].shape == (16 * 256,)
+    assert wavs[0].dtype == np.int16
+
+
+def test_synth_samples_griffin_lim(tmp_path, synthetic_preprocessed):
+    """Vocoder-free path writes playable wavs + plots for every real item."""
+    import jax
+
+    from speakingstyle_tpu.data import BucketedBatcher, SpeechDataset
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.synthesis import synth_one_sample, synth_samples
+
+    cfg = tiny_config(
+        preprocess=PreprocessConfig(
+            path=PathConfig(preprocessed_path=synthetic_preprocessed)
+        ),
+    )
+    model = build_model(cfg)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    ds = SpeechDataset("val.txt", cfg, sort=False, drop_last=False)
+    batcher = BucketedBatcher(ds, max_src=96, max_mel=96)
+    batch = next(batcher.epoch(shuffle=False))
+    arrays = batch.arrays()
+    out = model.apply(
+        {"params": variables["params"],
+         "batch_stats": variables.get("batch_stats", {})},
+        speakers=arrays["speakers"], texts=arrays["texts"],
+        src_lens=arrays["src_lens"], mels=arrays["mels"],
+        mel_lens=arrays["mel_lens"], max_mel_len=arrays["mels"].shape[1],
+        p_targets=arrays["pitches"], e_targets=arrays["energies"],
+        d_targets=arrays["durations"], deterministic=True,
+    )
+    paths = synth_samples(batch, out, None, cfg, str(tmp_path), plot=True)
+    assert len(paths) == batch.n_real
+    import scipy.io.wavfile
+
+    sr, wav = scipy.io.wavfile.read(paths[0])
+    assert sr == 22050 and wav.dtype == np.int16 and len(wav) > 0
+    assert os.path.exists(os.path.join(str(tmp_path), f"{batch.ids[0]}.png"))
+
+    fig, wav_recon, wav_pred, name = synth_one_sample(batch, out, None, cfg)
+    assert wav_recon.dtype == np.int16 and name == batch.ids[0]
+    import matplotlib.pyplot as plt
+
+    plt.close(fig)
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+def test_cli_parsers_build():
+    from speakingstyle_tpu.__main__ import main
+
+    with pytest.raises(SystemExit):  # no command
+        main([])
+    with pytest.raises(SystemExit):  # help works
+        main(["train", "--help"])
+
+
+def test_cli_train_smoke(tmp_path, synthetic_preprocessed, monkeypatch):
+    """python -m speakingstyle_tpu train on the synthetic dataset."""
+    import yaml
+
+    from speakingstyle_tpu.__main__ import main
+
+    pre = {"path": {"preprocessed_path": synthetic_preprocessed}}
+    mdl = {
+        "transformer": {"encoder_layer": 1, "decoder_layer": 1,
+                        "encoder_hidden": 32, "decoder_hidden": 32,
+                        "conv_filter_size": 64},
+        "reference_encoder": {"encoder_layer": 1, "encoder_hidden": 32,
+                              "conv_filter_size": 64},
+        "variance_predictor": {"filter_size": 32},
+        "variance_embedding": {"n_bins": 16},
+        "max_seq_len": 96,
+    }
+    trn = {
+        "path": {"ckpt_path": str(tmp_path / "ckpt"),
+                 "log_path": str(tmp_path / "log"),
+                 "result_path": str(tmp_path / "result")},
+        "optimizer": {"batch_size": 4},
+        "step": {"total_step": 2, "log_step": 1, "val_step": 100,
+                 "save_step": 2, "synth_step": 100},
+    }
+    paths = {}
+    for name, doc in (("preprocess", pre), ("model", mdl), ("train", trn)):
+        p = tmp_path / f"{name}.yaml"
+        p.write_text(yaml.safe_dump(doc))
+        paths[name] = str(p)
+    main(["train", "-p", paths["preprocess"], "-m", paths["model"],
+          "-t", paths["train"], "--max_steps", "2", "--data_parallel", "1"])
+    assert (tmp_path / "ckpt" / "2").exists()
+    assert "Step 1" in (tmp_path / "log" / "log.txt").read_text()
+
+    # evaluate restores the checkpoint it just wrote
+    losses = main(["evaluate", "-p", paths["preprocess"], "-m", paths["model"],
+                   "-t", paths["train"]])
+    assert "total_loss" in losses
+
+
+def test_trainer_default_synth_callback(tmp_path, synthetic_preprocessed):
+    """run_training with synth_callback='default' renders a sample and logs
+    throughput without error."""
+    from speakingstyle_tpu.training.trainer import run_training
+
+    cfg = tiny_config(
+        preprocess=PreprocessConfig(
+            path=PathConfig(preprocessed_path=synthetic_preprocessed)
+        ),
+        train=TrainConfig(
+            path=TrainPathConfig(
+                ckpt_path=str(tmp_path / "ckpt"),
+                log_path=str(tmp_path / "log"),
+                result_path=str(tmp_path / "result"),
+            ),
+        ),
+    )
+    object.__setattr__(cfg.train.optimizer, "batch_size", 4)
+    object.__setattr__(cfg.train.step, "total_step", 2)
+    object.__setattr__(cfg.train.step, "log_step", 1)
+    object.__setattr__(cfg.train.step, "synth_step", 2)
+    object.__setattr__(cfg.train.step, "val_step", 100)
+    object.__setattr__(cfg.train.step, "save_step", 100)
+    state = run_training(cfg, max_steps=2, synth_callback="default")
+    assert int(state.step) == 2
+    log = (tmp_path / "log" / "log.txt").read_text()
+    assert "[perf] Step" in log and "mel-frames/s" in log
