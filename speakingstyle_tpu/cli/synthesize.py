@@ -36,7 +36,10 @@ def build_parser(parser=None):
         "--ref_audio", type=str, default=None,
         help="reference wav for the speaking style, single mode only (required)",
     )
-    parser.add_argument("--speaker_id", type=int, default=0)
+    parser.add_argument(
+        "--speaker_id", type=str, default="0",
+        help="numeric id or speaker name from speakers.json (single mode)",
+    )
     parser.add_argument(
         "--pitch_control", type=str, default="1.0",
         help="scalar, or comma-separated per-word factors",
@@ -152,13 +155,24 @@ def main(args):
             cfg.preprocess.path.preprocessed_path, "speakers.json"
         )
         speaker = 0
-        if cfg.model.multi_speaker and os.path.exists(speakers_path):
-            with open(speakers_path) as f:
-                speaker_map = json.load(f)
-            # accept either a numeric id or a speaker name (the reference
-            # crashes on this lookup — synthesize.py:272, SURVEY.md §2.5)
-            key = str(args.speaker_id)
-            speaker = speaker_map.get(key, args.speaker_id)
+        if cfg.model.multi_speaker:
+            # accept a speaker NAME from speakers.json (its keys) or a raw
+            # numeric id (the reference crashes on exactly this lookup —
+            # synthesize.py:272, SURVEY.md §2.5)
+            if os.path.exists(speakers_path):
+                with open(speakers_path) as f:
+                    speaker_map = json.load(f)
+                if args.speaker_id in speaker_map:
+                    speaker = speaker_map[args.speaker_id]
+                elif args.speaker_id.lstrip("-").isdigit():
+                    speaker = int(args.speaker_id)
+                else:
+                    raise SystemExit(
+                        f"unknown speaker {args.speaker_id!r}; known: "
+                        f"{sorted(speaker_map)[:10]}..."
+                    )
+            elif args.speaker_id.lstrip("-").isdigit():
+                speaker = int(args.speaker_id)
 
         L = bucket_length(len(sequence), 16)
         T = bucket_length(mel.shape[0], 64)

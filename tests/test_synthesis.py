@@ -101,7 +101,7 @@ def test_english_word_spans_and_sequence():
 
 
 def test_expand_word_controls_variants():
-    spans = [("a", ["X", "Y"]), ("b", ["Z"])]
+    spans = [("a", ["HH", "AH0"]), ("b", ["W"])]
     np.testing.assert_allclose(expand_word_controls(spans, 2.0), [2, 2, 2])
     np.testing.assert_allclose(expand_word_controls(spans, [1.0, 3.0]), [1, 1, 3])
     np.testing.assert_allclose(
@@ -109,6 +109,16 @@ def test_expand_word_controls_variants():
     )
     with pytest.raises(ValueError):
         expand_word_controls(spans, [1.0])
+
+
+def test_expand_word_controls_stays_aligned_with_dropped_phones():
+    """text_to_sequence silently drops out-of-inventory phones; the control
+    array must apply the same filter or every later word's factor shifts."""
+    spans = [("a", ["HH", "ZZZNOTAPHONE"]), ("b", ["W"])]
+    seq = spans_to_sequence(spans, ["english_cleaners"])
+    ctrl = expand_word_controls(spans, [1.0, 3.0])
+    assert len(ctrl) == len(seq) == 2
+    np.testing.assert_allclose(ctrl, [1.0, 3.0])  # word b keeps its factor
 
 
 def test_pad_control():
