@@ -7,9 +7,13 @@ ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
 
 `vs_baseline` is relative to an estimated single-A100 PyTorch throughput of
 the reference at the same batch geometry (no published numbers exist;
-BASELINE.json "published": {}). The estimate is documented in
-A100_BASELINE_FRAMES_PER_SEC; the ≥3× north-star target corresponds to
-vs_baseline ≥ 3.0.
+BASELINE.json "published": {}). The 250k denominator is DERIVED in
+BASELINE_NOTES.md (two independent anchors: the reference's own 1080Ti
+anecdote scaled to A100, and an A100 utilization bound over the XLA-counted
+step FLOPs — both land at 200-250k; we use the top of the range so
+vs_baseline is a lower bound). `python bench.py --flops` prints the
+compiled step's cost analysis. The ≥3x north-star corresponds to
+vs_baseline >= 3.0, i.e. >= 750k mel-frames/s/chip.
 
 Measured perf notes (v5e single chip, 2026-07 round 1):
   * step ≈ 6.5 TFLOP (ref-encoder 1024-ch convs + decoder k=9 FFN convs
@@ -25,28 +29,25 @@ Measured perf notes (v5e single chip, 2026-07 round 1):
 """
 
 import json
+import os
+import subprocess
+import sys
 import time
-
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from speakingstyle_tpu.configs.config import Config
-from speakingstyle_tpu.models.factory import build_model, init_variables
-from speakingstyle_tpu.training.optim import make_optimizer
-from speakingstyle_tpu.training.state import TrainState
-from speakingstyle_tpu.training.trainer import make_train_step
 
 # Estimated reference (PyTorch, unoptimized research code, fp32, Python
 # length-regulator loop) single-A100 training throughput at batch 48 ×
-# ~600 frames. No published number exists; this anchors vs_baseline.
+# ~600 frames. No published number exists; BASELINE_NOTES.md derives the
+# 200-250k plausible range — this is its top, making vs_baseline a lower
+# bound on the true speedup.
 A100_BASELINE_FRAMES_PER_SEC = 250_000.0
 
 B, L_SRC, T_MEL = 48, 100, 600
 WARMUP_STEPS, BENCH_STEPS = 3, 20
 
 
-def make_batch(n_mels: int, rng: np.random.Generator):
+def make_batch(n_mels: int, rng):
+    import jax.numpy as jnp
+
     d = T_MEL // L_SRC
     return dict(
         speakers=jnp.zeros((B,), jnp.int32),
@@ -60,10 +61,28 @@ def make_batch(n_mels: int, rng: np.random.Generator):
     )
 
 
-def main():
+def main(report_flops: bool = False):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from speakingstyle_tpu.configs.config import Config
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.training.optim import make_optimizer
+    from speakingstyle_tpu.training.state import TrainState
+    from speakingstyle_tpu.training.trainer import make_train_step
+
     # XLA-native RBG PRNG for dropout masks (TrainConfig.fast_prng):
     # threefry mask generation alone cost ~15% of the v5e step time.
     jax.config.update("jax_default_prng_impl", "rbg")
+    # Persistent compile cache: the driver re-runs this every round and the
+    # tunneled-TPU AOT compile is the slowest part — warm runs skip it.
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     cfg = Config()
     model = build_model(cfg)
     variables = init_variables(model, cfg, jax.random.PRNGKey(0))
@@ -77,6 +96,23 @@ def main():
     )
     batch = jax.device_put(batch)
     rng = jax.random.PRNGKey(1)
+
+    if report_flops:
+        compiled = train_step.lower(state, batch, rng).compile()
+        cost = compiled.cost_analysis()
+        cost = cost[0] if isinstance(cost, (list, tuple)) else cost
+        flops = float(cost.get("flops", float("nan")))
+        print(
+            json.dumps(
+                {
+                    "metric": "train_step_flops",
+                    "value": flops,
+                    "unit": "FLOP/step",
+                    "per_frame_mflop": round(flops / (B * T_MEL) / 1e6, 1),
+                }
+            )
+        )
+        return
 
     for _ in range(WARMUP_STEPS):
         state, losses = train_step(state, batch, rng)
@@ -102,5 +138,67 @@ def main():
     )
 
 
+def _run_guarded():
+    """Run the measurement in a timeout-guarded child and ALWAYS print one
+    JSON line.
+
+    The tunneled-TPU backend is flaky (round 2: a backend exception aborted
+    the bench with rc=1 and no JSON; `jax.devices()` has been observed to
+    hang outright). A hang or crash inside this process would leave the
+    driver record empty, so the JAX work runs in a child: on failure retry
+    once, and on final failure emit {"..., "value": null, "error": ...} with
+    rc 0 so the record is always parseable.
+    """
+    deadline = time.monotonic() + 540.0
+    errors = []
+    for attempt in range(2):
+        budget = deadline - time.monotonic()
+        if budget < 30:
+            errors.append("no time budget left for retry")
+            break
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--inner"],
+                capture_output=True,
+                text=True,
+                timeout=min(360.0, budget),
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            errors.append(f"attempt {attempt + 1}: timeout")
+            continue
+        json_line = next(
+            (
+                ln
+                for ln in reversed(proc.stdout.strip().splitlines())
+                if ln.startswith("{")
+            ),
+            None,
+        )
+        if proc.returncode == 0 and json_line:
+            print(json_line)
+            return
+        errors.append(
+            f"attempt {attempt + 1}: rc={proc.returncode} "
+            f"stderr={proc.stderr[-700:]!r}"
+        )
+    print(
+        json.dumps(
+            {
+                "metric": "train_mel_frames_per_sec",
+                "value": None,
+                "unit": "mel-frames/sec/chip",
+                "vs_baseline": None,
+                "error": " | ".join(errors)[-1500:],
+            }
+        )
+    )
+
+
 if __name__ == "__main__":
-    main()
+    if "--flops" in sys.argv:
+        main(report_flops=True)
+    elif "--inner" in sys.argv:
+        main()
+    else:
+        _run_guarded()

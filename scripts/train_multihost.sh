@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Multi-host TPU pod-slice launch (v5e-16 and up).
+#
+# A pod slice runs ONE copy of this script per host (e.g. via
+# `gcloud compute tpus tpu-vm ssh --worker=all --command=...`). Each
+# process must call jax.distributed.initialize() before any other JAX
+# API so the hosts form a single global device mesh; the framework's
+# trainer then shards the global batch across every chip in the slice
+# exactly as in the single-host case — XLA routes the gradient
+# all-reduce over ICI within a host and DCN between hosts.
+#
+# SPEAKINGSTYLE_MULTIHOST=1 makes the CLI call
+# jax.distributed.initialize() at startup (coordinator discovery is
+# automatic on TPU VMs via the metadata server).
+#
+# Usage (on every worker simultaneously):
+#   SPEAKINGSTYLE_MULTIHOST=1 bash scripts/train_multihost.sh BC2013
+set -euo pipefail
+
+PRESET="${1:?usage: train_multihost.sh <PRESET> [extra train args...]}"
+shift
+
+export SPEAKINGSTYLE_MULTIHOST=1
+exec python -m speakingstyle_tpu train \
+  --preset "${PRESET}" \
+  --restore_step -1 \
+  "$@"

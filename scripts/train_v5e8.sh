@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Single-host TPU training launch (v5e-8 / v4-8 / any single TPU VM).
+#
+# TPU-native replacement for the reference's 4-GPU Slurm job
+# (reference: scripts/train_job.sh:9-18,39 — sbatch + conda +
+# nn.DataParallel). On a TPU VM there is no scheduler or NCCL: JAX sees
+# all local chips, and the framework shards the batch over a
+# (data, model) jax.sharding.Mesh with XLA emitting the gradient
+# all-reduce over ICI.
+#
+# Usage, from a TPU VM with this repo and the preprocessed dataset:
+#   bash scripts/train_v5e8.sh BC2013            # preset name
+#   bash scripts/train_v5e8.sh LJSpeech --model_parallel 2
+#
+# All extra args are forwarded to `speakingstyle_tpu train`.
+set -euo pipefail
+
+PRESET="${1:?usage: train_v5e8.sh <PRESET> [extra train args...]}"
+shift
+
+# One process owns all local chips (the default TPU VM runtime).
+# --data_parallel defaults to every local device; pass --model_parallel N
+# (or set train.sharding.model_axis in train.yaml) for tensor parallelism.
+exec python -m speakingstyle_tpu train \
+  --preset "${PRESET}" \
+  --restore_step -1 \
+  "$@"

@@ -10,6 +10,7 @@ COMMANDS = (
     "preprocess",
     "prepare_align",
     "train_vocoder",
+    "vocode",
 )
 
 

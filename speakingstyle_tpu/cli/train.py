@@ -21,6 +21,11 @@ def build_parser(parser=None):
         help="data-axis size for the device mesh (default: all local devices)",
     )
     parser.add_argument(
+        "--model_parallel", type=int, default=None,
+        help="tensor-parallel degree over the mesh's model axis "
+        "(default: train.sharding.model_axis from the config)",
+    )
+    parser.add_argument(
         "--synth", action="store_true",
         help="render a GT-vs-predicted validation sample every synth_step",
     )
@@ -36,14 +41,44 @@ def build_parser(parser=None):
 
 
 def main(args):
+    import os
+
+    if os.environ.get("SPEAKINGSTYLE_MULTIHOST"):
+        # Pod-slice training: every host runs this process; initialize()
+        # must precede any other JAX call so the hosts form one global
+        # mesh (coordinator discovery is automatic on TPU VMs). See
+        # scripts/train_multihost.sh.
+        import jax
+
+        jax.distributed.initialize()
     import jax
 
     from speakingstyle_tpu.parallel.mesh import make_mesh
     from speakingstyle_tpu.training.trainer import run_training
 
     cfg = config_from_args(args)
-    n_dev = args.data_parallel or len(jax.devices())
-    mesh = make_mesh(data=n_dev, model=1) if n_dev > 1 else None
+    model_axis = (
+        args.model_parallel
+        if args.model_parallel is not None
+        else cfg.train.sharding.model_axis
+    )
+    n_total = len(jax.devices())
+    if args.data_parallel:
+        data_axis = args.data_parallel
+    elif cfg.train.sharding.data_axis > 0:
+        data_axis = cfg.train.sharding.data_axis
+    else:
+        data_axis = n_total // model_axis
+    n_dev = data_axis * model_axis
+    mesh = (
+        make_mesh(
+            data=data_axis,
+            model=model_axis,
+            devices=jax.devices()[:n_dev],
+        )
+        if n_dev > 1
+        else None
+    )
     vocoder = None
     if args.synth and args.vocoder_ckpt:
         from speakingstyle_tpu.synthesis import get_vocoder

@@ -1,0 +1,144 @@
+"""``vocode`` command: standalone HiFi-GAN inference without the acoustic model.
+
+TPU-native counterpart of the reference's two standalone scripts:
+  * mel-npy dir -> wav  (reference: hifigan/inference_e2e.py:36-62)
+  * wav dir -> mel -> wav resynthesis quality check
+    (reference: hifigan/inference.py:37-68)
+
+Mel inputs may be [T, n_mels] (this framework's preprocessed layout) or
+[n_mels, T] (the reference trainer's save layout) — detected by shape.
+Inputs are right-padded to a multiple of 64 frames so the jitted generator
+compiles once per bucket instead of once per file, then trimmed to the true
+length after upsampling.
+"""
+
+import argparse
+import os
+
+import numpy as np
+
+from speakingstyle_tpu.cli import add_config_args, config_from_args
+
+PAD_FRAMES = 64
+LOG_MEL_FLOOR = float(np.log(1e-5))  # dynamic_range_compression clip floor
+
+
+def build_parser(parser=None):
+    parser = parser or argparse.ArgumentParser(description=__doc__)
+    add_config_args(parser)
+    src = parser.add_mutually_exclusive_group(required=True)
+    src.add_argument(
+        "--input_mels_dir", type=str, default=None,
+        help="directory of mel .npy files to vocode",
+    )
+    src.add_argument(
+        "--input_wavs_dir", type=str, default=None,
+        help="directory of .wav files to resynthesize (wav -> mel -> wav)",
+    )
+    parser.add_argument(
+        "--output_dir", type=str, default="generated_files",
+        help="where the generated wavs go",
+    )
+    parser.add_argument(
+        "--checkpoint_file", type=str, required=True,
+        help="HiFi-GAN generator: torch generator_*.pth.tar or this "
+        "framework's *.generator.msgpack",
+    )
+    parser.add_argument(
+        "--hifigan_config", type=str, default=None,
+        help="generator config.json (defaults to the vendored LJSpeech "
+        "V1 architecture)",
+    )
+    return parser
+
+
+def _load_mel(path: str, n_mels: int) -> np.ndarray:
+    """.npy -> [T, n_mels], accepting either orientation."""
+    mel = np.load(path).astype(np.float32)
+    if mel.ndim != 2:
+        raise ValueError(f"{path}: expected 2-D mel, got shape {mel.shape}")
+    if mel.shape[0] == n_mels and mel.shape[1] != n_mels:
+        mel = mel.T
+    return mel
+
+
+def _vocode_one(gen, params, mel: np.ndarray, max_wav_value: float):
+    """[T, n_mels] -> int16 wav, padding T to a compile bucket first."""
+    from speakingstyle_tpu.models.hifigan import vocoder_infer
+
+    T = mel.shape[0]
+    pad_to = -(-T // PAD_FRAMES) * PAD_FRAMES
+    mel = np.pad(
+        mel, ((0, pad_to - T), (0, 0)), constant_values=LOG_MEL_FLOOR
+    )
+    return vocoder_infer(
+        gen, params, mel[None], lengths=[T], max_wav_value=max_wav_value
+    )[0]
+
+
+def main(args):
+    import scipy.io.wavfile
+
+    from speakingstyle_tpu.synthesis import get_vocoder
+
+    cfg = config_from_args(args)
+    audio_cfg = cfg.preprocess.preprocessing.audio
+    n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+    gen, params = get_vocoder(
+        cfg, ckpt_path=args.checkpoint_file, config_path=args.hifigan_config
+    )
+    os.makedirs(args.output_dir, exist_ok=True)
+
+    written = []
+    if args.input_mels_dir:
+        names = sorted(
+            f for f in os.listdir(args.input_mels_dir) if f.endswith(".npy")
+        )
+        for name in names:
+            mel = _load_mel(os.path.join(args.input_mels_dir, name), n_mels)
+            wav = _vocode_one(gen, params, mel, audio_cfg.max_wav_value)
+            out = os.path.join(
+                args.output_dir,
+                os.path.splitext(name)[0] + "_generated_e2e.wav",
+            )
+            scipy.io.wavfile.write(out, audio_cfg.sampling_rate, wav)
+            print(out)
+            written.append(out)
+    else:
+        from speakingstyle_tpu.audio.stft import MelExtractor, get_mel_from_wav
+        from speakingstyle_tpu.audio.tools import load_wav
+
+        stft_cfg = cfg.preprocess.preprocessing.stft
+        extractor = MelExtractor(
+            filter_length=stft_cfg.filter_length,
+            hop_length=stft_cfg.hop_length,
+            win_length=stft_cfg.win_length,
+            n_mel_channels=n_mels,
+            sampling_rate=audio_cfg.sampling_rate,
+            mel_fmin=cfg.preprocess.preprocessing.mel.mel_fmin,
+            mel_fmax=cfg.preprocess.preprocessing.mel.mel_fmax,
+        )
+        names = sorted(
+            f for f in os.listdir(args.input_wavs_dir) if f.endswith(".wav")
+        )
+        for name in names:
+            audio, _ = load_wav(
+                os.path.join(args.input_wavs_dir, name),
+                target_sr=audio_cfg.sampling_rate,
+            )
+            mel, _ = get_mel_from_wav(audio, extractor)  # [n_mels, T]
+            wav = _vocode_one(gen, params, mel.T, audio_cfg.max_wav_value)
+            out = os.path.join(
+                args.output_dir,
+                os.path.splitext(name)[0] + "_generated.wav",
+            )
+            scipy.io.wavfile.write(out, audio_cfg.sampling_rate, wav)
+            print(out)
+            written.append(out)
+    if not written:
+        raise SystemExit("no input files found")
+    return written
+
+
+if __name__ == "__main__":
+    main(build_parser().parse_args())

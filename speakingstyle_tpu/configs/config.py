@@ -176,6 +176,18 @@ class ModelConfig:
     # TPU-specific knobs (no reference counterpart):
     compute_dtype: str = "bfloat16"  # activations/matmul dtype under jit
     use_reference_encoder: bool = True
+    # "dense" or "ring": ring engages sequence-parallel exact attention
+    # (parallel/ring_attention.py) in the encoder/decoder FFT stacks for
+    # inference beyond max_seq_len — build the model with a seq mesh
+    # (models/factory.build_model(..., seq_mesh=...)); sequence lengths
+    # must divide by the mesh's seq axis.
+    attention_impl: str = "dense"
+
+    def __post_init__(self):
+        if self.attention_impl not in ("dense", "ring"):
+            raise ValueError(
+                f"attention_impl must be dense|ring, got {self.attention_impl}"
+            )
 
 
 # ---------------------------------------------------------------------------

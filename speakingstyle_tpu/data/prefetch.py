@@ -30,9 +30,22 @@ class DevicePrefetcher:
     def _put(self, batch: Batch):
         arrays = batch.arrays()
         if self.sharding is not None:
-            arrays = {
-                k: jax.device_put(v, self.sharding) for k, v in arrays.items()
-            }
+            if jax.process_count() > 1:
+                # Multi-host: every process builds the identical global
+                # batch (same dataset + seed => same shuffle), and each
+                # host materializes only its addressable shards. XLA then
+                # treats the result as one global array over the pod mesh.
+                arrays = {
+                    k: jax.make_array_from_callback(
+                        v.shape, self.sharding, lambda idx, v=v: v[idx]
+                    )
+                    for k, v in arrays.items()
+                }
+            else:
+                arrays = {
+                    k: jax.device_put(v, self.sharding)
+                    for k, v in arrays.items()
+                }
         return batch, arrays
 
     def _worker(self):

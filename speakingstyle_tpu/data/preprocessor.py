@@ -82,10 +82,14 @@ def phoneme_average(values: np.ndarray, durations: Sequence[int]) -> np.ndarray:
     durations = np.asarray(durations, np.int64)
     n = int(durations.sum())
     values = np.asarray(values, np.float64)[:n]
+    if values.size == 0:
+        return np.zeros(len(durations), np.float32)
     starts = np.concatenate([[0], np.cumsum(durations)[:-1]])
     # reduceat needs strictly valid indices; zero-duration spans share their
-    # start with the next phone — mask them to 0 afterwards
-    sums = np.add.reduceat(values, np.minimum(starts, max(n - 1, 0)))
+    # start with the next phone — mask them to 0 afterwards. Clamp against
+    # the ACTUAL value count: boundary rounding can leave `values` shorter
+    # than sum(durations), so n-1 alone is not a safe bound.
+    sums = np.add.reduceat(values, np.minimum(starts, len(values) - 1))
     # reduceat sums to the next index; for zero-duration entries it returns
     # the next span's sum, so divide by duration and zero them explicitly
     out = np.where(durations > 0, sums / np.maximum(durations, 1), 0.0)
@@ -394,4 +398,9 @@ class Preprocessor:
             if values.size:
                 vmin = min(vmin, float(values.min()))
                 vmax = max(vmax, float(values.max()))
+        if not (np.isfinite(vmin) and np.isfinite(vmax)):
+            # No feature files written this run: emit a valid (0, 0) range
+            # instead of serializing Infinity into stats.json (invalid JSON
+            # for strict parsers, and poisons downstream bin edges).
+            return 0.0, 0.0
         return vmin, vmax

@@ -34,7 +34,19 @@ def load_dataset_stats(cfg: Config) -> Tuple[tuple, tuple, int]:
     return pitch_stats, energy_stats, n_speakers
 
 
-def build_model(cfg: Config, n_position: Optional[int] = None) -> FastSpeech2:
+def build_model(
+    cfg: Config, n_position: Optional[int] = None, seq_mesh=None
+) -> FastSpeech2:
+    """``seq_mesh`` (a Mesh with a "seq" axis) is required when
+    cfg.model.attention_impl == "ring"; build one with
+    parallel.mesh.make_seq_mesh() for long-sequence inference."""
+    if cfg.model.attention_impl == "ring" and seq_mesh is None:
+        raise ValueError(
+            'attention_impl="ring" needs a seq mesh: '
+            "build_model(cfg, seq_mesh=make_seq_mesh())"
+        )
+    if cfg.model.attention_impl != "ring":
+        seq_mesh = None
     pitch_stats, energy_stats, n_speakers = load_dataset_stats(cfg)
     return FastSpeech2(
         config=cfg,
@@ -42,6 +54,7 @@ def build_model(cfg: Config, n_position: Optional[int] = None) -> FastSpeech2:
         energy_stats=energy_stats,
         n_speakers=n_speakers,
         n_position=n_position,
+        seq_mesh=seq_mesh,
     )
 
 

@@ -30,6 +30,9 @@ class FastSpeech2(nn.Module):
     energy_stats: tuple = (-2.0, 10.0)
     n_speakers: int = 1
     n_position: Optional[int] = None  # override for long-sequence inference
+    # jax.sharding.Mesh with a "seq" axis: engages ring attention in the
+    # encoder/decoder stacks (config.model.attention_impl == "ring")
+    seq_mesh: Optional[object] = None
 
     @nn.compact
     def __call__(
@@ -85,6 +88,7 @@ class FastSpeech2(nn.Module):
             n_position=n_position,
             remat=self.config.train.sharding.remat,
             dtype=dtype,
+            seq_mesh=self.seq_mesh,
             name="encoder",
         )(texts, src_pad_mask, gammas, betas, deterministic=deterministic)
 
@@ -134,6 +138,7 @@ class FastSpeech2(nn.Module):
             n_position=n_position,
             remat=self.config.train.sharding.remat,
             dtype=dtype,
+            seq_mesh=self.seq_mesh,
             name="decoder",
         )(va["features"], va["mel_pad_mask"], gammas, betas, deterministic=deterministic)
 
@@ -142,11 +147,24 @@ class FastSpeech2(nn.Module):
             dtype=dtype,
             name="mel_linear",
         )(dec)
+        postnet_in = mel_out
+        postnet_keep = None
+        if d_targets is None:
+            # Free-running: the reference's postnet buffer ends hard at the
+            # batch-max predicted length, so every conv layer zero-pads
+            # there (dynamic shape). Our static buffer extends further —
+            # zero the input past that boundary AND re-zero each layer
+            # (PostNet keep_mask) or bias/BatchNorm junk beyond it leaks
+            # back through the 5-layer receptive field
+            # (reference: model/fastspeech2.py:109, modules.py:137-144).
+            global_max = jnp.max(va["mel_lens"])
+            postnet_keep = jnp.arange(mel_out.shape[1]) < global_max
+            postnet_in = jnp.where(postnet_keep[None, :, None], mel_out, 0.0)
         postnet_residual = PostNet(
             n_mel_channels=self.config.preprocess.preprocessing.mel.n_mel_channels,
             dtype=dtype,
             name="postnet",
-        )(mel_out, deterministic=deterministic)
+        )(postnet_in, deterministic=deterministic, keep_mask=postnet_keep)
         mel_postnet = mel_out + postnet_residual
 
         return {

@@ -40,12 +40,19 @@ class FiLM(nn.Module):
 
 
 class MultiHeadSelfAttention(nn.Module):
-    """Post-LN multi-head self-attention (reference: transformer/SubLayers.py:8-57)."""
+    """Post-LN multi-head self-attention (reference: transformer/SubLayers.py:8-57).
+
+    ``seq_mesh`` switches the score computation to sequence-parallel ring
+    attention (parallel/ring_attention.py) — exact, never materializing
+    [L, L] per device — for inference beyond max_seq_len. L must divide
+    by the mesh's ``seq`` axis.
+    """
 
     n_head: int
     d_model: int
     dropout: float
     dtype: jnp.dtype = jnp.float32
+    seq_mesh: Optional[object] = None  # jax.sharding.Mesh with a "seq" axis
 
     @nn.compact
     def __call__(self, x, pad_mask, deterministic: bool):
@@ -57,13 +64,36 @@ class MultiHeadSelfAttention(nn.Module):
         k = dense("w_ks")(x).reshape(B, L, self.n_head, d_head)
         v = dense("w_vs")(x).reshape(B, L, self.n_head, d_head)
 
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
-            jnp.asarray(d_head, jnp.float32)
-        ).astype(self.dtype)
-        logits = logits.astype(jnp.float32) + attention_bias(pad_mask, jnp.float32)
-        attn = nn.softmax(logits, axis=-1).astype(self.dtype)
+        if self.seq_mesh is not None:
+            from speakingstyle_tpu.parallel.ring_attention import (
+                ring_self_attention,
+            )
 
-        out = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(B, L, self.d_model)
+            # f32 end-to-end inside the ring (matches the dense path's f32
+            # softmax); [B, L, H, D] -> [B, H, L, D]
+            out = ring_self_attention(
+                q.transpose(0, 2, 1, 3).astype(jnp.float32),
+                k.transpose(0, 2, 1, 3).astype(jnp.float32),
+                v.transpose(0, 2, 1, 3).astype(jnp.float32),
+                attention_bias(pad_mask, jnp.float32),
+                mesh=self.seq_mesh,
+            )
+            out = (
+                out.transpose(0, 2, 1, 3)
+                .reshape(B, L, self.d_model)
+                .astype(self.dtype)
+            )
+        else:
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+                jnp.asarray(d_head, jnp.float32)
+            ).astype(self.dtype)
+            logits = logits.astype(jnp.float32) + attention_bias(
+                pad_mask, jnp.float32
+            )
+            attn = nn.softmax(logits, axis=-1).astype(self.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(
+                B, L, self.d_model
+            )
         out = nn.Dense(self.d_model, dtype=self.dtype, name="fc")(out)
         out = nn.Dropout(self.dropout)(out, deterministic=deterministic)
         out = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype, name="layer_norm")(
@@ -120,11 +150,13 @@ class FFTBlock(nn.Module):
     dropout: float
     film: bool = True
     dtype: jnp.dtype = jnp.float32
+    seq_mesh: Optional[object] = None
 
     @nn.compact
     def __call__(self, x, pad_mask, gammas=None, betas=None, deterministic=True):
         x = MultiHeadSelfAttention(
-            self.n_head, self.d_model, self.dropout, dtype=self.dtype, name="slf_attn"
+            self.n_head, self.d_model, self.dropout, dtype=self.dtype,
+            seq_mesh=self.seq_mesh, name="slf_attn"
         )(x, pad_mask, deterministic)
         x = mask_fill(x, pad_mask)
         x = ConvFFN(

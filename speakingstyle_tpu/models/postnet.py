@@ -20,9 +20,20 @@ class PostNet(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, mel, deterministic=True):
-        """mel: [B, T, n_mels] -> residual [B, T, n_mels]."""
+    def __call__(self, mel, deterministic=True, keep_mask=None):
+        """mel: [B, T, n_mels] -> residual [B, T, n_mels].
+
+        ``keep_mask`` ([T] or [B, T] bool, True = real frame): when given,
+        every layer's output is re-zeroed at masked frames. Free-running
+        inference needs this for reference parity — the reference's buffer
+        ends hard at the batch-max predicted length, so each of its conv
+        layers zero-pads there, while our static buffer extends further
+        and intermediate bias/BatchNorm junk past the boundary would leak
+        back in through the 5-layer receptive field.
+        """
         x = mel.astype(self.dtype)
+        if keep_mask is not None and keep_mask.ndim == 1:
+            keep_mask = keep_mask[None, :]
         for i in range(self.n_convolutions):
             is_last = i == self.n_convolutions - 1
             out_ch = self.n_mel_channels if is_last else self.embedding_dim
@@ -43,4 +54,6 @@ class PostNet(nn.Module):
             if not is_last:
                 x = jnp.tanh(x)
             x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
+            if keep_mask is not None:
+                x = jnp.where(keep_mask[..., None], x, 0.0)
         return x

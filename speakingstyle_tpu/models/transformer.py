@@ -34,6 +34,7 @@ class FFTStack(nn.Module):
     film: bool = True
     remat: bool = False
     dtype: jnp.dtype = jnp.float32
+    seq_mesh: Optional[object] = None  # engages ring attention when set
 
     @nn.compact
     def __call__(self, x, pad_mask, gammas=None, betas=None, deterministic=True):
@@ -52,6 +53,7 @@ class FFTStack(nn.Module):
                 dropout=self.dropout,
                 film=self.film,
                 dtype=self.dtype,
+                seq_mesh=self.seq_mesh,
                 name=f"layer_{i}",
             )(x, pad_mask, gammas, betas, deterministic)
         return x
@@ -70,6 +72,7 @@ class Encoder(nn.Module):
     vocab_size: int = VOCAB_SIZE
     remat: bool = False
     dtype: jnp.dtype = jnp.float32
+    seq_mesh: Optional[object] = None
 
     @nn.compact
     def __call__(self, token_ids, pad_mask, gammas=None, betas=None, deterministic=True):
@@ -90,6 +93,7 @@ class Encoder(nn.Module):
             film=True,
             remat=self.remat,
             dtype=self.dtype,
+            seq_mesh=self.seq_mesh,
             name="layer_stack",
         )(x, pad_mask, gammas, betas, deterministic)
 
@@ -106,6 +110,7 @@ class Decoder(nn.Module):
     n_position: int = 1001
     remat: bool = False
     dtype: jnp.dtype = jnp.float32
+    seq_mesh: Optional[object] = None
 
     @nn.compact
     def __call__(self, x, pad_mask, gammas=None, betas=None, deterministic=True):
@@ -120,5 +125,6 @@ class Decoder(nn.Module):
             film=True,
             remat=self.remat,
             dtype=self.dtype,
+            seq_mesh=self.seq_mesh,
             name="layer_stack",
         )(x, pad_mask, gammas, betas, deterministic)

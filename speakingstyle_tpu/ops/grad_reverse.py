@@ -1,0 +1,30 @@
+"""Gradient reversal: identity forward, -alpha-scaled gradient backward.
+
+Counterpart of the reference's GradientReversalLayer/RevGrad
+(reference: model/blocks.py:7-40) — unused on the reference's main
+training path but part of its public surface (adversarial
+speaker/style disentanglement experiments). JAX-native as a
+``custom_vjp`` pure function; compose it inside any module:
+
+    x = grad_reverse(x, alpha=0.5)
+"""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def grad_reverse(x, alpha: float = 1.0):
+    return x
+
+
+def _fwd(x, alpha):
+    return x, None
+
+
+def _bwd(alpha, _, g):
+    return (jax.tree_util.tree_map(lambda t: -alpha * t, g),)
+
+
+grad_reverse.defvjp(_fwd, _bwd)

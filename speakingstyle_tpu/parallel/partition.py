@@ -87,3 +87,58 @@ def count_sharded(params, mesh: Mesh, rules=None) -> int:
     """How many leaves actually get a non-replicated spec (introspection)."""
     sh = flatten_dict(tp_shardings(params, mesh, rules), sep="/")
     return sum(1 for s in sh.values() if s.spec != P())
+
+
+def opt_state_shardings(opt_state, params, param_shardings, mesh: Mesh):
+    """Shardings for an optax state given the parameter shardings.
+
+    Any subtree of ``opt_state`` structurally identical to ``params`` (Adam
+    mu/nu, MultiSteps grad accumulators) gets ``param_shardings``; every
+    other leaf (step counters, scalars) is replicated. Recurses through the
+    tuple/namedtuple/dict nesting optax states are built from.
+    """
+    import jax.tree_util as jtu
+
+    repl = NamedSharding(mesh, P())
+    p_treedef = jtu.tree_structure(params)
+
+    def rec(node):
+        if jtu.tree_structure(node) == p_treedef:
+            return param_shardings
+        if isinstance(node, dict):
+            return {k: rec(v) for k, v in node.items()}
+        if isinstance(node, tuple):
+            mapped = [rec(c) for c in node]
+            # namedtuples (optax states) take positional fields; plain
+            # tuples (optax.chain containers) take one iterable
+            if hasattr(node, "_fields"):
+                return type(node)(*mapped)
+            return tuple(mapped)
+        return repl
+
+    return rec(opt_state)
+
+
+def train_state_shardings(state, mesh: Mesh, rules=None):
+    """TrainState pytree -> matching pytree of NamedShardings.
+
+    params follow DEFAULT_TP_RULES over the mesh's ``model`` axis; the optax
+    state mirrors them; step/batch_stats replicate. Feed the result to
+    ``jax.jit(in_shardings=...)`` / ``jax.device_put``.
+    """
+    p_sh = tp_shardings(state.params, mesh, rules)
+    repl = NamedSharding(mesh, P())
+    return state.replace(
+        step=repl,
+        params=p_sh,
+        batch_stats=jax.tree_util.tree_map(lambda _: repl, state.batch_stats),
+        opt_state=opt_state_shardings(state.opt_state, state.params, p_sh, mesh),
+    )
+
+
+def shard_train_state(state, mesh: Mesh, rules=None):
+    """device_put a TrainState with TP parameter (+ mirrored optimizer)
+    shardings; the pure-DP special case (model axis size 1) reduces to full
+    replication."""
+    sh = train_state_shardings(state, mesh, rules)
+    return jax.tree_util.tree_map(jax.device_put, state, sh)

@@ -71,7 +71,24 @@ def get_vocoder(
             jax.random.PRNGKey(0), np.zeros((1, 16, n_mels), np.float32)
         )["params"]
         with open(ckpt_path, "rb") as f:
-            params = serialization.from_bytes(init, f.read())
+            raw = f.read()
+        # The vocoder trainer saves TWO artifacts: the full VocoderState
+        # (gen+disc params and optimizer moments) as vocoder_*.msgpack and a
+        # generator-only sidecar *.generator.msgpack. Only the latter matches
+        # the generator template — detect the full-state file and say so
+        # instead of failing deep inside from_bytes.
+        try:
+            state_dict = serialization.msgpack_restore(raw)
+        except Exception:
+            state_dict = None
+        if isinstance(state_dict, dict) and "gen_params" in state_dict:
+            raise ValueError(
+                f"{ckpt_path!r} is a full VocoderState checkpoint (generator "
+                "+ discriminators + optimizer state). Pass the generator-only "
+                "sidecar saved next to it (*.generator.msgpack), or extract "
+                "state['gen_params'] yourself."
+            )
+        params = serialization.from_bytes(init, raw)
     elif ckpt_path:
         from speakingstyle_tpu.compat.torch_convert import (
             convert_hifigan,

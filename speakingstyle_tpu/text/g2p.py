@@ -20,7 +20,20 @@ _WORD_SPLIT_RE = re.compile(r"([,;.\-\?\!\s+])")
 
 def read_lexicon(path: str) -> Dict[str, List[str]]:
     """word -> phone list; first pronunciation wins (reference:
-    synthesize.py:26-35)."""
+    synthesize.py:26-35).
+
+    The pinyin lexicon is self-hosting: if ``path`` names the standard
+    ``pinyin-lexicon-r.txt`` and the file does not exist yet, it is
+    generated in place from ``text/pinyin_lexicon.py`` (the reference
+    vendors it as opaque data; we derive it from pinyin phonology).
+    """
+    import os
+
+    if not os.path.exists(path) and os.path.basename(path) == "pinyin-lexicon-r.txt":
+        from speakingstyle_tpu.text.pinyin_lexicon import write_lexicon
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        write_lexicon(path)
     lexicon: Dict[str, List[str]] = {}
     with open(path, encoding="utf-8") as f:
         for line in f:

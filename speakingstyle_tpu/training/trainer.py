@@ -40,8 +40,14 @@ def _model_kwargs(arrays: Dict, teacher_forced: bool) -> Dict:
     return kw
 
 
-def make_train_step(model, tx, cfg: Config, mesh=None):
-    """Returns jitted fn(state, arrays, rng) -> (state, losses)."""
+def make_train_step(model, tx, cfg: Config, mesh=None, state_shardings=None):
+    """Returns jitted fn(state, arrays, rng) -> (state, losses).
+
+    ``state_shardings`` (a TrainState pytree of NamedShardings, see
+    parallel/partition.train_state_shardings) engages tensor parallelism
+    over the mesh's ``model`` axis; omitted, the state is replicated
+    (pure DP — the reference's only strategy, SURVEY.md §2.4).
+    """
     lambda_f = cfg.train.loss.lambda_f
     p_level = cfg.preprocess.preprocessing.pitch.feature
     e_level = cfg.preprocess.preprocessing.energy.feature
@@ -87,15 +93,17 @@ def make_train_step(model, tx, cfg: Config, mesh=None):
         return jax.jit(step_fn, donate_argnums=(0,))
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P("data"))
+    if state_shardings is None:
+        state_shardings = repl  # pure DP: state fully replicated
     return jax.jit(
         step_fn,
-        in_shardings=(repl, data, repl),
-        out_shardings=(repl, repl),
+        in_shardings=(state_shardings, data, repl),
+        out_shardings=(state_shardings, repl),
         donate_argnums=(0,),
     )
 
 
-def make_eval_step(model, cfg: Config, mesh=None):
+def make_eval_step(model, cfg: Config, mesh=None, state_shardings=None):
     """Teacher-forced loss evaluation (reference: evaluate.py:39-58)."""
     lambda_f = cfg.train.loss.lambda_f
     p_level = cfg.preprocess.preprocessing.pitch.feature
@@ -123,7 +131,11 @@ def make_eval_step(model, cfg: Config, mesh=None):
         return jax.jit(eval_fn)
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P("data"))
-    return jax.jit(eval_fn, in_shardings=(repl, data), out_shardings=repl)
+    if state_shardings is None:
+        state_shardings = repl
+    return jax.jit(
+        eval_fn, in_shardings=(state_shardings, data), out_shardings=repl
+    )
 
 
 def make_predict_step(model, cfg: Config, mesh=None):
@@ -225,12 +237,25 @@ def run_training(
             ignore_layers=cfg.train.ignore_layers,
         )
 
+    state_shardings = None
     if mesh is not None:
-        repl = NamedSharding(mesh, P())
-        state = jax.device_put(state, repl)
+        if mesh.shape.get("model", 1) > 1:
+            from speakingstyle_tpu.parallel.partition import (
+                shard_train_state,
+                train_state_shardings,
+            )
 
-    train_step = make_train_step(model, tx, cfg, mesh=mesh)
-    eval_step = make_eval_step(model, cfg, mesh=mesh)
+            state_shardings = train_state_shardings(state, mesh)
+            state = shard_train_state(state, mesh)
+        else:
+            state = jax.device_put(state, NamedSharding(mesh, P()))
+
+    train_step = make_train_step(
+        model, tx, cfg, mesh=mesh, state_shardings=state_shardings
+    )
+    eval_step = make_eval_step(
+        model, cfg, mesh=mesh, state_shardings=state_shardings
+    )
 
     max_src = max_mel = cfg.model.max_seq_len
     pad_mult = mesh.shape["data"] if mesh is not None else 1

@@ -105,7 +105,12 @@ def init_vocoder_state(
     schedule = optax.exponential_decay(
         hp.learning_rate, hp.lr_decay_steps, hp.lr_decay, staircase=True
     )
-    mk_opt = lambda: optax.adamw(schedule, b1=hp.adam_b1, b2=hp.adam_b2)
+    # weight_decay pinned to torch AdamW's default (0.01): optax.adamw
+    # defaults to 1e-4, which would silently diverge from the reference's
+    # HiFi-GAN recipe (hifigan/train.py AdamW with torch defaults).
+    mk_opt = lambda: optax.adamw(
+        schedule, b1=hp.adam_b1, b2=hp.adam_b2, weight_decay=0.01
+    )
     gen_tx, disc_tx = mk_opt(), mk_opt()
     state = VocoderState(
         step=jnp.zeros((), jnp.int32),

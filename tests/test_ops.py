@@ -83,3 +83,20 @@ def test_make_bins():
     assert lin.shape == (5,) and lin[0] == 0.0 and lin[-1] == 10.0
     log = make_bins(1.0, 100.0, 5, "log")
     assert log[0] == pytest.approx(1.0) and log[-1] == pytest.approx(100.0)
+
+
+def test_grad_reverse():
+    """Identity forward; -alpha * g backward (reference: model/blocks.py:7-40)."""
+    import jax
+    import jax.numpy as jnp
+
+    from speakingstyle_tpu.ops.grad_reverse import grad_reverse
+
+    x = jnp.asarray([1.0, -2.0, 3.0])
+    np.testing.assert_array_equal(np.asarray(grad_reverse(x, 0.7)), np.asarray(x))
+
+    g = jax.grad(lambda x: (grad_reverse(x, 0.7) ** 2).sum())(x)
+    np.testing.assert_allclose(np.asarray(g), -0.7 * 2 * np.asarray(x), rtol=1e-6)
+    # jits and composes with other grads
+    g2 = jax.jit(jax.grad(lambda x: grad_reverse(x, 2.0).sum() + x.sum()))(x)
+    np.testing.assert_allclose(np.asarray(g2), np.full(3, -2.0 + 1.0), rtol=1e-6)

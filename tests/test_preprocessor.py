@@ -367,3 +367,35 @@ def test_ljspeech_prepare_align(tmp_path):
         raw / "LJSpeech" / "LJ001-0001.wav"
     )
     assert sr == SR and pcm.dtype == np.int16
+
+
+def test_phoneme_average_values_shorter_than_durations():
+    """Boundary rounding can leave fewer frames than sum(durations); the
+    averaging must clamp against the real frame count, not sum(durations)-1
+    (regression: IndexError aborted corpus builds late)."""
+    durations = [3, 4, 2]           # sum = 9
+    values = np.arange(7.0)         # 2 frames short
+    out = phoneme_average(values, durations)
+    assert out.shape == (3,)
+    assert np.isfinite(out).all()
+    np.testing.assert_allclose(out[0], values[0:3].mean())
+
+
+def test_phoneme_average_empty_values():
+    out = phoneme_average(np.zeros(0), [2, 3])
+    np.testing.assert_allclose(out, [0.0, 0.0])
+
+
+def test_normalize_dir_empty_written_is_finite(tmp_path):
+    """stats.json must stay valid JSON when a run writes zero feature files
+    (regression: (inf, -inf) serialized as Infinity)."""
+    out = str(tmp_path / "pre")
+    os.makedirs(os.path.join(out, "pitch"))
+    cfg = Config(
+        preprocess=PreprocessConfig(
+            path=PathConfig(raw_path=str(tmp_path), preprocessed_path=out),
+        )
+    )
+    vmin, vmax = Preprocessor(cfg)._normalize_dir("pitch", 0.0, 1.0, [])
+    assert np.isfinite(vmin) and np.isfinite(vmax)
+    json.dumps({"pitch": [vmin, vmax]})  # must not raise / emit Infinity

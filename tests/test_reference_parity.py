@@ -106,6 +106,21 @@ def reference_run(tmp_path_factory):
     (mel, postnet_mel, p_pred, e_pred, log_d_pred, d_rounded,
      src_masks, mel_masks, src_lens, mel_lens) = out
 
+    # Free-running pass: same style mel, NO p/e/d targets — the synthesis
+    # path (reference: model/modules.py:137-144 predicted durations).
+    with torch.no_grad(), contextlib.redirect_stdout(io.StringIO()):
+        fr = ref_model(
+            speakers=torch.zeros(B, dtype=torch.long),
+            texts=torch.from_numpy(texts),
+            src_lens=torch.tensor(SRC_LENS),
+            max_src_len=L_SRC,
+            mels=torch.from_numpy(mels),
+            mel_lens=torch.tensor(MEL_LENS),
+            max_mel_len=T_MEL,
+        )
+    (fr_mel, fr_postnet, fr_p, fr_e, fr_logd, fr_d_rounded,
+     _, _, _, fr_mel_lens) = fr
+
     sd = {k: v.detach().cpu().numpy() for k, v in ref_model.state_dict().items()}
     outputs = {
         "mel": mel.numpy(),
@@ -113,6 +128,11 @@ def reference_run(tmp_path_factory):
         "pitch_prediction": p_pred.numpy(),
         "energy_prediction": e_pred.numpy(),
         "log_duration_prediction": log_d_pred.numpy(),
+        "fr_mel": fr_mel.numpy(),
+        "fr_mel_postnet": fr_postnet.numpy(),
+        "fr_durations": fr_d_rounded.numpy(),
+        "fr_mel_lens": fr_mel_lens.numpy(),
+        "fr_log_duration_prediction": fr_logd.numpy(),
     }
     return sd, outputs, str(stats_dir)
 
@@ -174,3 +194,62 @@ def test_fastspeech2_numerical_parity(reference_run):
         got, want = np.broadcast_arrays(got * valid, want * valid)
         err = np.abs(got - want).max()
         assert err < 2e-4, f"{key}: max abs err {err}"
+
+
+def test_fastspeech2_free_running_parity(reference_run):
+    """The SYNTHESIS path: no targets — predicted durations
+    round(exp(logd)-1)*control (ops/length_regulator.py:51-61) and the
+    rebuilt mel mask must agree with the reference's inference branch
+    (model/modules.py:137-144), and the mels must match on the predicted
+    valid region. This is exactly what ships to users via `synthesize`."""
+    import jax.numpy as jnp
+
+    from speakingstyle_tpu.compat.torch_convert import convert_fastspeech2
+    from speakingstyle_tpu.models.factory import build_model
+
+    sd, ref_out, stats_dir = reference_run
+    converted = convert_fastspeech2(sd)
+    cfg = _our_config(stats_dir)
+    model = build_model(cfg)
+
+    texts, mels, pitches, energies = _fixed_batch()
+    MAX_MEL = 96  # static bound; must exceed every predicted length
+    out = model.apply(
+        {"params": converted["params"], "batch_stats": converted["batch_stats"]},
+        speakers=jnp.zeros((B,), jnp.int32),
+        texts=jnp.asarray(texts, jnp.int32),
+        src_lens=jnp.asarray(SRC_LENS, jnp.int32),
+        mels=jnp.asarray(mels),
+        mel_lens=jnp.asarray(MEL_LENS, jnp.int32),
+        max_mel_len=MAX_MEL,
+        deterministic=True,
+    )
+
+    ref_d = ref_out["fr_durations"]
+    ref_lens = ref_out["fr_mel_lens"].astype(np.int64)
+    src_valid = np.arange(L_SRC)[None, :] < np.asarray(SRC_LENS)[:, None]
+
+    # the predicted lengths must stay inside the static bound, or the
+    # comparison below silently truncates
+    assert ref_lens.max() < MAX_MEL and ref_lens.max() > 0
+
+    got_logd = np.asarray(out["log_duration_prediction"]) * src_valid
+    want_logd = ref_out["fr_log_duration_prediction"] * src_valid
+    np.testing.assert_allclose(got_logd, want_logd, atol=2e-4)
+
+    # durations: integer agreement, not approximate — one frame off shifts
+    # every downstream frame
+    np.testing.assert_array_equal(
+        np.asarray(out["durations"]) * src_valid,
+        ref_d.astype(np.int64) * src_valid,
+    )
+    np.testing.assert_array_equal(np.asarray(out["mel_lens"]), ref_lens)
+
+    T_ref = ref_out["fr_mel"].shape[1]
+    mel_valid = (np.arange(T_ref)[None, :] < ref_lens[:, None])[..., None]
+    for key in ("mel", "mel_postnet"):
+        got = np.asarray(out[key], np.float32)[:, :T_ref]
+        want = ref_out[f"fr_{key}"]
+        got, want = np.broadcast_arrays(got * mel_valid, want * mel_valid)
+        err = np.abs(got - want).max()
+        assert err < 5e-4, f"free-running {key}: max abs err {err}"

@@ -74,3 +74,45 @@ def test_number_words():
     assert ordinal_to_words(12) == "twelfth"
     assert ordinal_to_words(30) == "thirtieth"
     assert ordinal_to_words(101) == "one hundred and first"
+
+
+def test_pinyin_lexicon_generator(tmp_path):
+    """The generated MFA dict must match the reference's vendored
+    pinyin-lexicon-r.txt entry-for-entry (embedding-row parity), and
+    read_lexicon must self-generate it when missing."""
+    import os
+
+    from speakingstyle_tpu.text.g2p import read_lexicon
+    from speakingstyle_tpu.text.pinyin_lexicon import entries, write_lexicon
+
+    all_entries = list(entries())
+    assert len(all_entries) == 4120
+    keys = [k for k, _ in all_entries]
+    assert len(set(keys)) == 4115  # er1..er5 carry two pronunciations
+    # spot checks covering every decomposition rule family
+    d = {}
+    for k, p in all_entries:
+        d.setdefault(k, p)
+    assert d["zhi1"] == ["zh", "iii1"]
+    assert d["si3"] == ["s", "ii3"]
+    assert d["ju2"] == ["j", "v2"]
+    assert d["liu4"] == ["l", "iou4"]
+    assert d["dui1"] == ["d", "uei1"]
+    assert d["lun2"] == ["l", "uen2"]
+    assert d["weng5"] == ["w", "uen5"]
+    assert d["you3"] == ["y", "iou3"]
+    assert d["yuan1"] == ["y", "van1"]
+    assert d["a5"] == ["a5"]
+    assert d["zuor1"] == ["z", "uo1", "rr"]
+    assert d["er1"] == ["er1"]
+
+    ref_path = "/root/reference/lexicon/pinyin-lexicon-r.txt"
+    if os.path.exists(ref_path):
+        ref = {tuple(l.split()) for l in open(ref_path)}
+        ours = {(k, *p) for k, p in all_entries}
+        assert ours == ref
+
+    # read_lexicon self-generates a missing pinyin lexicon
+    path = str(tmp_path / "lex" / "pinyin-lexicon-r.txt")
+    lex = read_lexicon(path)
+    assert os.path.exists(path) and lex["ni3"] == ["n", "i3"]

@@ -173,3 +173,55 @@ def test_vocoder_train_step_sharded():
     mels = jnp.asarray(rng.standard_normal((8, SEG // 256, 80)), jnp.float32)
     state, metrics = step(state, wavs, mels)
     assert np.isfinite(float(metrics["gen_loss"]))
+
+
+def test_vocoder_optimizer_torch_adamw_weight_decay():
+    """The GAN optimizers must use torch AdamW's default weight decay (0.01),
+    not optax.adamw's 1e-4 (regression: silent recipe divergence). With zero
+    gradients the AdamW update reduces to -lr * wd * param."""
+    from speakingstyle_tpu.training.vocoder_trainer import (
+        VocoderHParams,
+        init_vocoder_state,
+    )
+
+    cfg = Config()
+    hp = VocoderHParams(segment_size=SEG)
+    state, gen, mpd, msd, gen_tx, disc_tx = init_vocoder_state(
+        cfg, hp, jax.random.PRNGKey(0)
+    )
+    zero_grads = jax.tree_util.tree_map(jnp.zeros_like, state.gen_params)
+    updates, _ = gen_tx.update(zero_grads, state.gen_opt, state.gen_params)
+    flat_u = jax.tree_util.tree_leaves(updates)
+    flat_p = jax.tree_util.tree_leaves(state.gen_params)
+    # pick a leaf with nonzero params (conv kernels always are)
+    for u, p in zip(flat_u, flat_p):
+        if float(jnp.abs(p).max()) > 1e-3:
+            ratio = np.asarray(u) / np.asarray(p)
+            np.testing.assert_allclose(
+                ratio, -hp.learning_rate * 0.01, rtol=1e-4
+            )
+            return
+    raise AssertionError("no nonzero parameter leaf found")
+
+
+def test_get_vocoder_rejects_full_state_msgpack(tmp_path):
+    """Passing the trainer's primary vocoder_*.msgpack (a full VocoderState)
+    to get_vocoder must fail with a pointer at the generator sidecar, not an
+    opaque deserialization error (regression)."""
+    from speakingstyle_tpu.synthesis import get_vocoder
+    from speakingstyle_tpu.training.vocoder_trainer import (
+        VocoderHParams,
+        init_vocoder_state,
+        save_vocoder,
+    )
+
+    cfg = Config()
+    hp = VocoderHParams(segment_size=SEG)
+    state, *_ = init_vocoder_state(cfg, hp, jax.random.PRNGKey(0))
+    full_path = str(tmp_path / "vocoder_00000001.msgpack")
+    gen_path = save_vocoder(full_path, state)
+    with pytest.raises(ValueError, match="generator.msgpack"):
+        get_vocoder(cfg, full_path)
+    # the sidecar still loads fine
+    gen2, params2 = get_vocoder(cfg, gen_path)
+    assert params2 is not None
