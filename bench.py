@@ -24,8 +24,13 @@ Measured perf notes (v5e single chip, 2026-07 round 1):
     fix): compute-bound, not dispatch- or batch-bound.
   * threefry dropout-mask generation cost ~15% of the step; the RBG
     default (TrainConfig.fast_prng) recovers it -> ~320k frames/s.
-  * further gains need FLOP-level changes (e.g. bf16 softmax, fused
-    conv+LN Pallas kernel) — tracked for a later round.
+  * round 4 FLOP-level work (the 1.28x -> 3x plan): ~90% of step FLOPs
+    are conv1d; ``model.conv_impl`` now selects the lowering — "unfold"
+    (default) turns every conv into one im2col GEMM the MXU tiles at
+    near-peak, "pallas" is the fused conv+bias+ReLU+LN kernel
+    (ops/pallas_conv.py), "xla" the old spatial-conv emitter. Plus a
+    bf16-softmax knob. ``python bench.py --ab`` measures all variants;
+    ``--inner --profile`` writes a jax.profiler trace to ./profile_trace.
 """
 
 import json
@@ -61,7 +66,23 @@ def make_batch(n_mels: int, rng):
     )
 
 
-def main(report_flops: bool = False):
+_T0 = time.monotonic()
+
+
+def _mark(msg: str) -> None:
+    """Timestamped stderr breadcrumb.
+
+    The round-3 driver record was `value: null, error: timeout` with no way
+    to tell WHERE the 360 s died (device acquisition? compile? execute?).
+    Every stage below emits one of these; on timeout the guard tails them
+    into the error field.
+    """
+    print(f"[bench +{time.monotonic() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def main(report_flops: bool = False, profile: bool = False,
+         overrides: dict = None):
+    _mark("importing jax")
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -83,12 +104,23 @@ def main(report_flops: bool = False):
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    _mark("acquiring devices (tunneled-TPU backend init hangs here when sick)")
+    devs = jax.devices()
+    _mark(f"devices acquired: {devs}")
     cfg = Config()
+    if overrides:
+        import dataclasses
+
+        cfg = dataclasses.replace(
+            cfg, model=dataclasses.replace(cfg.model, **overrides)
+        )
     model = build_model(cfg)
+    _mark("initializing variables")
     variables = init_variables(model, cfg, jax.random.PRNGKey(0))
     tx = make_optimizer(cfg.train)
     state = TrainState.create(variables, tx)
     train_step = make_train_step(model, tx, cfg, mesh=None)
+    _mark("variables initialized")
 
     batch = make_batch(
         cfg.preprocess.preprocessing.mel.n_mel_channels,
@@ -114,9 +146,21 @@ def main(report_flops: bool = False):
         )
         return
 
+    _mark("compile start (train_step.lower().compile())")
+    compiled = train_step.lower(state, batch, rng).compile()
+    _mark("compile end")
+
     for _ in range(WARMUP_STEPS):
-        state, losses = train_step(state, batch, rng)
+        state, losses = compiled(state, batch, rng)
     jax.block_until_ready(losses["total_loss"])
+    _mark("warmup done; measuring")
+    train_step = compiled
+
+    if profile:
+        trace_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "profile_trace"
+        )
+        jax.profiler.start_trace(trace_dir)
 
     t0 = time.perf_counter()
     for _ in range(BENCH_STEPS):
@@ -124,18 +168,51 @@ def main(report_flops: bool = False):
     jax.block_until_ready(losses["total_loss"])
     dt = time.perf_counter() - t0
 
+    if profile:
+        jax.profiler.stop_trace()
+        _mark(f"trace written to {trace_dir}")
+
     frames_per_step = B * T_MEL
     fps = frames_per_step * BENCH_STEPS / dt
-    print(
-        json.dumps(
-            {
-                "metric": "train_mel_frames_per_sec",
-                "value": round(fps, 1),
-                "unit": "mel-frames/sec/chip",
-                "vs_baseline": round(fps / A100_BASELINE_FRAMES_PER_SEC, 3),
-            }
+    out = {
+        "metric": "train_mel_frames_per_sec",
+        "value": round(fps, 1),
+        "unit": "mel-frames/sec/chip",
+        "vs_baseline": round(fps / A100_BASELINE_FRAMES_PER_SEC, 3),
+    }
+    if overrides:
+        out["overrides"] = overrides
+    print(json.dumps(out))
+
+
+def run_ab():
+    """A/B the performance knobs (README "Performance knobs"): one process
+    per variant so each gets a clean backend; prints one JSON line each."""
+    variants = [
+        {"conv_impl": "xla"},
+        {"conv_impl": "unfold"},
+        {"conv_impl": "pallas"},
+        {"conv_impl": "unfold", "attention_softmax_dtype": "bfloat16"},
+    ]
+    for ov in variants:
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--inner",
+                 "--overrides", json.dumps(ov)],
+                capture_output=True,
+                text=True,
+                timeout=600,
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+            )
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"error": "timeout after 600s", "overrides": ov}))
+            continue
+        line = next(
+            (ln for ln in reversed(proc.stdout.strip().splitlines())
+             if ln.startswith("{")),
+            None,
         )
-    )
+        print(line or json.dumps({"error": proc.stderr[-300:], "overrides": ov}))
 
 
 def _run_guarded():
@@ -149,24 +226,39 @@ def _run_guarded():
     once, and on final failure emit {"..., "value": null, "error": ...} with
     rc 0 so the record is always parseable.
     """
-    deadline = time.monotonic() + 540.0
-    errors = []
-    for attempt in range(2):
-        budget = deadline - time.monotonic()
-        if budget < 30:
-            errors.append("no time budget left for retry")
-            break
+    here = os.path.dirname(os.path.abspath(__file__))
+    err_path = os.path.join(here, ".bench_stderr.log")
+    error = None
+    # ONE attempt with the whole budget. Round 3 proved a retry is useless
+    # here: the failure mode is a deterministically slow cold compile over
+    # the TPU tunnel, so 2x360 s guarantees two timeouts where 1x520 s could
+    # have finished. Child stderr streams to a file (not a pipe buffer) so a
+    # killed child still leaves its breadcrumbs behind.
+    with open(err_path, "w") as err_f:
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--inner"],
-                capture_output=True,
+                stdout=subprocess.PIPE,
+                stderr=err_f,
                 text=True,
-                timeout=min(360.0, budget),
-                cwd=os.path.dirname(os.path.abspath(__file__)),
+                timeout=520.0,
+                cwd=here,
             )
         except subprocess.TimeoutExpired:
-            errors.append(f"attempt {attempt + 1}: timeout")
-            continue
+            proc = None
+            error = "timeout after 520s"
+    breadcrumbs = ""
+    try:
+        with open(err_path) as f:
+            all_lines = f.read().splitlines()
+        marks = [ln for ln in all_lines if "[bench +" in ln]
+        # keep the exception text too (a crash's traceback tail), not just
+        # the stage markers
+        other = [ln for ln in all_lines if "[bench +" not in ln and ln.strip()]
+        breadcrumbs = " ; ".join(marks[-6:] + other[-4:])
+    except OSError:
+        pass
+    if proc is not None:
         json_line = next(
             (
                 ln
@@ -178,10 +270,7 @@ def _run_guarded():
         if proc.returncode == 0 and json_line:
             print(json_line)
             return
-        errors.append(
-            f"attempt {attempt + 1}: rc={proc.returncode} "
-            f"stderr={proc.stderr[-700:]!r}"
-        )
+        error = f"rc={proc.returncode}"
     print(
         json.dumps(
             {
@@ -189,7 +278,7 @@ def _run_guarded():
                 "value": None,
                 "unit": "mel-frames/sec/chip",
                 "vs_baseline": None,
-                "error": " | ".join(errors)[-1500:],
+                "error": f"{error} | last breadcrumbs: {breadcrumbs}"[-1500:],
             }
         )
     )
@@ -198,7 +287,12 @@ def _run_guarded():
 if __name__ == "__main__":
     if "--flops" in sys.argv:
         main(report_flops=True)
+    elif "--ab" in sys.argv:
+        run_ab()
     elif "--inner" in sys.argv:
-        main()
+        ov = None
+        if "--overrides" in sys.argv:
+            ov = json.loads(sys.argv[sys.argv.index("--overrides") + 1])
+        main(profile="--profile" in sys.argv, overrides=ov)
     else:
         _run_guarded()

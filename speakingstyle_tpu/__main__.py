@@ -11,6 +11,7 @@ COMMANDS = (
     "prepare_align",
     "train_vocoder",
     "vocode",
+    "convert",
 )
 
 

@@ -175,6 +175,16 @@ class ModelConfig:
     vocoder: VocoderConfig = field(default_factory=VocoderConfig)
     # TPU-specific knobs (no reference counterpart):
     compute_dtype: str = "bfloat16"  # activations/matmul dtype under jit
+    # conv1d lowering for the FLOP-dominant conv stacks (ops/conv.py):
+    # "xla" = lax.conv emitter, "unfold" = im2col GEMM (one large MXU
+    # matmul per conv), "pallas" = fused conv+bias+ReLU(+LN) kernel
+    # (ops/pallas_conv.py). Param trees are identical — switchable on a
+    # restored checkpoint.
+    conv_impl: str = "unfold"
+    # softmax accumulation dtype in attention: "float32" (reference-parity
+    # default) or "bfloat16" (A/B candidate; attention is <1% of step
+    # FLOPs so this mostly saves VPU/memory traffic)
+    attention_softmax_dtype: str = "float32"
     use_reference_encoder: bool = True
     # "dense" or "ring": ring engages sequence-parallel exact attention
     # (parallel/ring_attention.py) in the encoder/decoder FFT stacks for
@@ -187,6 +197,22 @@ class ModelConfig:
         if self.attention_impl not in ("dense", "ring"):
             raise ValueError(
                 f"attention_impl must be dense|ring, got {self.attention_impl}"
+            )
+        if self.conv_impl not in ("xla", "unfold", "pallas"):
+            raise ValueError(
+                f"conv_impl must be xla|unfold|pallas, got {self.conv_impl}"
+            )
+        if self.attention_softmax_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                "attention_softmax_dtype must be float32|bfloat16, "
+                f"got {self.attention_softmax_dtype}"
+            )
+        if self.attention_impl == "ring" and self.attention_softmax_dtype != "float32":
+            # the ring path accumulates its running softmax in f32 by design
+            # (parallel/ring_attention.py); a bf16 label would misreport A/Bs
+            raise ValueError(
+                'attention_impl="ring" supports only '
+                'attention_softmax_dtype="float32"'
             )
 
 

@@ -54,6 +54,8 @@ class FastSpeech2(nn.Module):
         cfg = self.config.model
         tf = cfg.transformer
         dtype = jnp.dtype(cfg.compute_dtype)
+        sm_dtype = jnp.dtype(cfg.attention_softmax_dtype)
+        conv_impl = cfg.conv_impl
         n_position = self.n_position or (cfg.max_seq_len + 1)
 
         B, L_src = texts.shape
@@ -74,7 +76,9 @@ class FastSpeech2(nn.Module):
                 d_model=ref.encoder_hidden,
                 dropout=ref.dropout,
                 n_position=n_position,
+                conv_impl=conv_impl,
                 dtype=dtype,
+                softmax_dtype=sm_dtype,
                 name="reference_encoder",
             )(mels, mel_pad_mask, deterministic=deterministic)
 
@@ -87,7 +91,9 @@ class FastSpeech2(nn.Module):
             dropout=tf.encoder_dropout,
             n_position=n_position,
             remat=self.config.train.sharding.remat,
+            conv_impl=conv_impl,
             dtype=dtype,
+            softmax_dtype=sm_dtype,
             seq_mesh=self.seq_mesh,
             name="encoder",
         )(texts, src_pad_mask, gammas, betas, deterministic=deterministic)
@@ -110,6 +116,7 @@ class FastSpeech2(nn.Module):
             filter_size=cfg.variance_predictor.filter_size,
             kernel_size=cfg.variance_predictor.kernel_size,
             dropout=cfg.variance_predictor.dropout,
+            conv_impl=conv_impl,
             dtype=dtype,
             name="variance_adaptor",
         )(
@@ -137,7 +144,9 @@ class FastSpeech2(nn.Module):
             dropout=tf.decoder_dropout,
             n_position=n_position,
             remat=self.config.train.sharding.remat,
+            conv_impl=conv_impl,
             dtype=dtype,
+            softmax_dtype=sm_dtype,
             seq_mesh=self.seq_mesh,
             name="decoder",
         )(va["features"], va["mel_pad_mask"], gammas, betas, deterministic=deterministic)
@@ -162,6 +171,7 @@ class FastSpeech2(nn.Module):
             postnet_in = jnp.where(postnet_keep[None, :, None], mel_out, 0.0)
         postnet_residual = PostNet(
             n_mel_channels=self.config.preprocess.preprocessing.mel.n_mel_channels,
+            conv_impl=conv_impl,
             dtype=dtype,
             name="postnet",
         )(postnet_in, deterministic=deterministic, keep_mask=postnet_keep)

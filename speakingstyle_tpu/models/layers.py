@@ -52,6 +52,7 @@ class MultiHeadSelfAttention(nn.Module):
     d_model: int
     dropout: float
     dtype: jnp.dtype = jnp.float32
+    softmax_dtype: jnp.dtype = jnp.float32
     seq_mesh: Optional[object] = None  # jax.sharding.Mesh with a "seq" axis
 
     @nn.compact
@@ -84,11 +85,12 @@ class MultiHeadSelfAttention(nn.Module):
                 .astype(self.dtype)
             )
         else:
+            sm_dtype = jnp.dtype(self.softmax_dtype)
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
                 jnp.asarray(d_head, jnp.float32)
             ).astype(self.dtype)
-            logits = logits.astype(jnp.float32) + attention_bias(
-                pad_mask, jnp.float32
+            logits = logits.astype(sm_dtype) + attention_bias(
+                pad_mask, sm_dtype
             )
             attn = nn.softmax(logits, axis=-1).astype(self.dtype)
             out = jnp.einsum("bhqk,bkhd->bqhd", attn, v).reshape(
@@ -109,23 +111,26 @@ class ConvFFN(nn.Module):
     d_inner: int
     kernel_sizes: Tuple[int, int]
     dropout: float
+    conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, deterministic: bool):
+        from speakingstyle_tpu.ops.conv import Conv1d
+
         residual = x
-        h = nn.Conv(
+        h = Conv1d(
             self.d_inner,
-            kernel_size=(self.kernel_sizes[0],),
-            padding="SAME",
+            kernel_size=self.kernel_sizes[0],
+            impl=self.conv_impl,
+            activation="relu",
             dtype=self.dtype,
             name="w_1",
         )(x)
-        h = nn.relu(h)
-        h = nn.Conv(
+        h = Conv1d(
             self.d_model,
-            kernel_size=(self.kernel_sizes[1],),
-            padding="SAME",
+            kernel_size=self.kernel_sizes[1],
+            impl=self.conv_impl,
             dtype=self.dtype,
             name="w_2",
         )(h)
@@ -149,13 +154,16 @@ class FFTBlock(nn.Module):
     kernel_sizes: Tuple[int, int]
     dropout: float
     film: bool = True
+    conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
+    softmax_dtype: jnp.dtype = jnp.float32
     seq_mesh: Optional[object] = None
 
     @nn.compact
     def __call__(self, x, pad_mask, gammas=None, betas=None, deterministic=True):
         x = MultiHeadSelfAttention(
             self.n_head, self.d_model, self.dropout, dtype=self.dtype,
+            softmax_dtype=self.softmax_dtype,
             seq_mesh=self.seq_mesh, name="slf_attn"
         )(x, pad_mask, deterministic)
         x = mask_fill(x, pad_mask)
@@ -164,6 +172,7 @@ class FFTBlock(nn.Module):
             self.d_inner,
             self.kernel_sizes,
             self.dropout,
+            conv_impl=self.conv_impl,
             dtype=self.dtype,
             name="pos_ffn",
         )(x, deterministic)
@@ -179,15 +188,18 @@ class ConvNorm(nn.Module):
     out_channels: int
     kernel_size: int = 1
     dilation: int = 1
+    conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        return nn.Conv(
+        from speakingstyle_tpu.ops.conv import Conv1d
+
+        return Conv1d(
             self.out_channels,
-            kernel_size=(self.kernel_size,),
-            kernel_dilation=(self.dilation,),
-            padding="SAME",
+            kernel_size=self.kernel_size,
+            dilation=self.dilation,
+            impl=self.conv_impl,
             dtype=self.dtype,
             name="conv",
         )(x)

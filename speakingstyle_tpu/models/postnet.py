@@ -10,6 +10,8 @@ strictly better behaved).
 import flax.linen as nn
 import jax.numpy as jnp
 
+from speakingstyle_tpu.ops.conv import Conv1d
+
 
 class PostNet(nn.Module):
     n_mel_channels: int = 80
@@ -17,6 +19,7 @@ class PostNet(nn.Module):
     kernel_size: int = 5
     n_convolutions: int = 5
     dropout: float = 0.5
+    conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -37,10 +40,10 @@ class PostNet(nn.Module):
         for i in range(self.n_convolutions):
             is_last = i == self.n_convolutions - 1
             out_ch = self.n_mel_channels if is_last else self.embedding_dim
-            x = nn.Conv(
+            x = Conv1d(
                 out_ch,
-                kernel_size=(self.kernel_size,),
-                padding="SAME",
+                kernel_size=self.kernel_size,
+                impl=self.conv_impl,
                 dtype=self.dtype,
                 name=f"conv_{i}",
             )(x)

@@ -31,7 +31,9 @@ class ReferenceEncoder(nn.Module):
     dropout: float = 0.1
     n_position: int = 1001
     true_length_mean: bool = False
+    conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
+    softmax_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, mel, pad_mask, deterministic=True):
@@ -43,14 +45,48 @@ class ReferenceEncoder(nn.Module):
         # reference, and the convs must not read arbitrary padding content
         x = mask_fill(mel.astype(self.dtype), pad_mask)
         for i in range(self.n_conv_layers):
-            x = ConvNorm(
-                self.conv_filter_size,
-                kernel_size=self.conv_kernel_size,
-                dtype=self.dtype,
-                name=f"conv_{i}",
-            )(x)
-            x = nn.relu(x)
-            x = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype, name=f"ln_{i}")(x)
+            if self.conv_impl == "pallas":
+                # whole conv->ReLU->LN sandwich in one fused kernel
+                # (ops/pallas_conv.py); ConvParams/AffineParams create the
+                # identical {conv_i/conv, ln_i} param entries the unfused
+                # path below does, so the impls share checkpoints.
+                from speakingstyle_tpu.ops.conv import AffineParams, ConvParams
+                from speakingstyle_tpu.ops.pallas_conv import fused_conv_relu_ln
+
+                class _Holder(nn.Module):
+                    features: int
+                    kernel_size: int
+
+                    @nn.compact
+                    def __call__(holder, cin):
+                        return ConvParams(
+                            holder.features, holder.kernel_size, name="conv"
+                        )(cin)
+
+                kernel, bias = _Holder(
+                    self.conv_filter_size,
+                    self.conv_kernel_size,
+                    name=f"conv_{i}",
+                )(x.shape[-1])
+                scale, beta = AffineParams(
+                    self.conv_filter_size, name=f"ln_{i}"
+                )()
+                kernel, bias, scale, beta = (
+                    a.astype(self.dtype) for a in (kernel, bias, scale, beta)
+                )
+                x = fused_conv_relu_ln(x, kernel, bias, scale, beta)
+            else:
+                x = ConvNorm(
+                    self.conv_filter_size,
+                    kernel_size=self.conv_kernel_size,
+                    conv_impl=self.conv_impl,
+                    dtype=self.dtype,
+                    name=f"conv_{i}",
+                )(x)
+                x = nn.relu(x)
+                x = nn.LayerNorm(
+                    epsilon=LN_EPS, dtype=self.dtype, name=f"ln_{i}"
+                )(x)
             x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
         x = mask_fill(x, pad_mask)
 
@@ -65,7 +101,9 @@ class ReferenceEncoder(nn.Module):
                 kernel_sizes=(self.conv_kernel_size, self.conv_kernel_size),
                 dropout=self.dropout,
                 film=False,
+                conv_impl=self.conv_impl,
                 dtype=self.dtype,
+                softmax_dtype=self.softmax_dtype,
                 name=f"fftb_{i}",
             )(x, pad_mask, deterministic=deterministic)
 

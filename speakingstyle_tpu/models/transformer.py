@@ -33,7 +33,9 @@ class FFTStack(nn.Module):
     n_position: int
     film: bool = True
     remat: bool = False
+    conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
+    softmax_dtype: jnp.dtype = jnp.float32
     seq_mesh: Optional[object] = None  # engages ring attention when set
 
     @nn.compact
@@ -52,7 +54,9 @@ class FFTStack(nn.Module):
                 kernel_sizes=self.kernel_sizes,
                 dropout=self.dropout,
                 film=self.film,
+                conv_impl=self.conv_impl,
                 dtype=self.dtype,
+                softmax_dtype=self.softmax_dtype,
                 seq_mesh=self.seq_mesh,
                 name=f"layer_{i}",
             )(x, pad_mask, gammas, betas, deterministic)
@@ -71,7 +75,9 @@ class Encoder(nn.Module):
     n_position: int = 1001
     vocab_size: int = VOCAB_SIZE
     remat: bool = False
+    conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
+    softmax_dtype: jnp.dtype = jnp.float32
     seq_mesh: Optional[object] = None
 
     @nn.compact
@@ -92,7 +98,9 @@ class Encoder(nn.Module):
             self.n_position,
             film=True,
             remat=self.remat,
+            conv_impl=self.conv_impl,
             dtype=self.dtype,
+            softmax_dtype=self.softmax_dtype,
             seq_mesh=self.seq_mesh,
             name="layer_stack",
         )(x, pad_mask, gammas, betas, deterministic)
@@ -109,7 +117,9 @@ class Decoder(nn.Module):
     dropout: float = 0.2
     n_position: int = 1001
     remat: bool = False
+    conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
+    softmax_dtype: jnp.dtype = jnp.float32
     seq_mesh: Optional[object] = None
 
     @nn.compact
@@ -124,7 +134,9 @@ class Decoder(nn.Module):
             self.n_position,
             film=True,
             remat=self.remat,
+            conv_impl=self.conv_impl,
             dtype=self.dtype,
+            softmax_dtype=self.softmax_dtype,
             seq_mesh=self.seq_mesh,
             name="layer_stack",
         )(x, pad_mask, gammas, betas, deterministic)

@@ -30,19 +30,22 @@ class VariancePredictor(nn.Module):
     filter_size: int = 256
     kernel_size: int = 3
     dropout: float = 0.5
+    conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, pad_mask, gammas=None, betas=None, deterministic=True):
+        from speakingstyle_tpu.ops.conv import Conv1d
+
         for i in (1, 2):
-            x = nn.Conv(
+            x = Conv1d(
                 self.filter_size,
-                kernel_size=(self.kernel_size,),
-                padding="SAME",
+                kernel_size=self.kernel_size,
+                impl=self.conv_impl,
+                activation="relu",
                 dtype=self.dtype,
                 name=f"conv1d_{i}",
             )(x)
-            x = nn.relu(x)
             x = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype, name=f"layer_norm_{i}")(x)
             x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
         if gammas is not None and betas is not None:
@@ -69,6 +72,7 @@ class VarianceAdaptor(nn.Module):
     filter_size: int = 256
     kernel_size: int = 3
     dropout: float = 0.5
+    conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
 
     def _bins(self, stats, quantization):
@@ -94,7 +98,8 @@ class VarianceAdaptor(nn.Module):
         deterministic: bool = True,
     ):
         mk_pred = lambda name: VariancePredictor(
-            self.filter_size, self.kernel_size, self.dropout, dtype=self.dtype, name=name
+            self.filter_size, self.kernel_size, self.dropout,
+            conv_impl=self.conv_impl, dtype=self.dtype, name=name
         )
         embed = lambda name: nn.Embed(self.n_bins, self.d_model, dtype=self.dtype, name=name)
 

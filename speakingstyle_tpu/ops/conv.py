@@ -1,0 +1,159 @@
+"""Conv1d implementations tuned for the MXU.
+
+The step-FLOP budget of the flagship model is ~90% 1-D convolutions
+(reference-encoder 1024-channel k=3 stack, decoder k=9 conv-FFN, postnet
+k=5 — reference: model/modules.py:307-406, transformer/SubLayers.py:60-93,
+transformer/Layers.py:78-148). How those lower onto the TPU matrix unit is
+therefore THE performance lever of the whole framework. Three
+param-compatible implementations, selected by ``ModelConfig.conv_impl``:
+
+* ``"xla"`` — ``lax.conv_general_dilated`` (flax nn.Conv's path): XLA's
+  spatial conv emitter. Baseline.
+* ``"unfold"`` — im2col reformulation: stack the K shifted input views and
+  contract with one ``[K*Cin, Cout]`` GEMM. Every FLOP lands on the MXU as
+  a single large matmul (e.g. the 1024-ch ref-encoder conv becomes
+  [B*T, 3072] @ [3072, 1024]); the backward pass autodiffs to two more
+  clean GEMMs. Costs K× activation reads — irrelevant while compute-bound.
+* ``"pallas"`` — the hand-written fused kernel (ops/pallas_conv.py):
+  conv + bias + ReLU (+ LayerNorm) in one VMEM pass, K-tap accumulation
+  in f32 without materializing the im2col buffer.
+
+All three produce identical math (tests/test_ops.py::test_conv1d_impl_parity
+in the fast CI gate; the full model-level A/B is
+tests/test_models.py::test_conv_impls_identical_tree_and_outputs)
+and the identical ``{"kernel": [K, Cin, Cout], "bias": [Cout]}`` param
+entry, so ``conv_impl`` can change per run — including on a restored
+checkpoint — without any conversion.
+"""
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+CONV_IMPLS = ("xla", "unfold", "pallas")
+
+
+def conv1d_unfold(x, kernel, bias=None, dilation: int = 1):
+    """SAME-padded 1-D conv as one GEMM. x [B,T,Cin], kernel [K,Cin,Cout]."""
+    K = kernel.shape[0]
+    if K == 1 and dilation == 1:
+        y = jnp.einsum("btc,co->bto", x, kernel[0])
+    else:
+        span = (K - 1) * dilation + 1
+        pad = (span - 1) // 2
+        T = x.shape[1]
+        xp = jnp.pad(x, ((0, 0), (pad, span - 1 - pad), (0, 0)))
+        cols = jnp.stack(
+            [
+                jax.lax.dynamic_slice_in_dim(xp, j * dilation, T, axis=1)
+                for j in range(K)
+            ],
+            axis=2,
+        )  # [B, T, K, Cin] — XLA fuses the stack into the GEMM operand
+        y = jnp.einsum("btkc,kco->bto", cols, kernel)
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+class Conv1d(nn.Module):
+    """Drop-in replacement for ``nn.Conv`` (1-D, SAME, channel-last) with a
+    selectable lowering. The param entry ({kernel [K,Cin,Cout], bias}) is
+    created by this module for every impl, so the tree is identical no
+    matter which lowering runs. ``activation="relu"`` fuses the ReLU into
+    the pallas kernel (elsewhere it is a separate — XLA-fused — op)."""
+
+    features: int
+    kernel_size: int
+    impl: str = "xla"
+    dilation: int = 1
+    use_bias: bool = True
+    activation: Optional[str] = None  # None | "relu"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if self.impl not in CONV_IMPLS:
+            raise ValueError(f"conv_impl must be one of {CONV_IMPLS}, got {self.impl!r}")
+        if self.activation not in (None, "relu"):
+            raise ValueError(f"activation must be None|relu, got {self.activation!r}")
+        cin = x.shape[-1]
+        # same initializers/layout as nn.Conv for checkpoint parity
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (self.kernel_size, cin, self.features),
+        )
+        bias = (
+            self.param("bias", nn.initializers.zeros, (self.features,))
+            if self.use_bias
+            else None
+        )
+        x, kernel, bias = nn.dtypes.promote_dtype(
+            x, kernel, bias, dtype=self.dtype
+        )
+        if self.impl == "pallas":
+            from speakingstyle_tpu.ops.pallas_conv import fused_conv1d
+
+            return fused_conv1d(
+                x, kernel, bias,
+                dilation=self.dilation,
+                relu=self.activation == "relu",
+            )
+        if self.impl == "unfold":
+            y = conv1d_unfold(x, kernel, bias, dilation=self.dilation)
+        else:
+            y = jax.lax.conv_general_dilated(
+                x,
+                kernel,
+                window_strides=(1,),
+                padding="SAME",
+                rhs_dilation=(self.dilation,),
+                dimension_numbers=("NWC", "WIO", "NWC"),
+            )
+            if bias is not None:
+                y = y + bias
+        if self.activation == "relu":
+            y = jnp.maximum(y, 0.0)
+        return y
+
+
+class ConvParams(nn.Module):
+    """Param-only twin of Conv1d ({kernel, bias}) for call sites that hand
+    the weights to a fused kernel (e.g. the reference-encoder
+    conv+ReLU+LN stack) instead of calling the conv op here."""
+
+    features: int
+    kernel_size: int
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, cin: int):
+        kernel = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (self.kernel_size, cin, self.features),
+        )
+        bias = (
+            self.param("bias", nn.initializers.zeros, (self.features,))
+            if self.use_bias
+            else None
+        )
+        return kernel, bias
+
+
+class AffineParams(nn.Module):
+    """Param holder matching ``nn.LayerNorm``'s tree ({scale, bias}) for
+    call sites that consume the affine inside a fused kernel instead of a
+    separate LayerNorm op."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self):
+        return (
+            self.param("scale", nn.initializers.ones, (self.features,)),
+            self.param("bias", nn.initializers.zeros, (self.features,)),
+        )

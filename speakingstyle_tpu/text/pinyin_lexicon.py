@@ -22,6 +22,16 @@ pinyin phonology: each syllable-with-tone decomposes into
 ``write_lexicon(path)`` emits the file: all plain syllable entries
 sorted by (syllable, tone), then all erhua entries. Run
 ``python -m speakingstyle_tpu.text.pinyin_lexicon --out lexicon/pinyin-lexicon-r.txt``.
+
+Content parity: the generated file is LINE-SET IDENTICAL to the
+reference's data file (4120 entries; verified by
+tests/test_text.py::test_pinyin_lexicon_generator). The only raw
+diff is line ORDER for 60 lines: the reference file was hand-edited, with
+``r1..r5`` spliced in before ``er*`` and the ``lve*``/``nve*`` spelling
+variants spliced immediately after ``lue*``/``nue*`` instead of in sorted
+position. Lexicon lookup (MFA and ``text/g2p.py``) is order-independent,
+so we keep deterministic sorted order rather than reproducing the manual
+insertion points.
 """
 
 import argparse

@@ -126,6 +126,7 @@ def _tree_shapes(tree):
 
 
 @pytest.mark.parametrize("dp_prefix", [False, True])
+@pytest.mark.slow
 def test_convert_fastspeech2_matches_init_tree(dp_prefix):
     sd = make_reference_state_dict()
     if dp_prefix:  # nn.DataParallel checkpoints (reference: train.py:45)
@@ -174,3 +175,54 @@ def test_converted_params_run_forward():
     )
     assert out["mel_postnet"].shape == (B, T, MELS)
     assert np.isfinite(np.asarray(out["mel_postnet"])).all()
+
+
+@pytest.mark.slow
+def test_convert_cli_roundtrip(tmp_path, synthetic_preprocessed):
+    """``python -m speakingstyle_tpu convert``: torch ckpt -> Orbax dir at
+    the filename's step, restorable, with the --eval_mel_l1 gate running a
+    real val pass (the runner VERDICT asks to have ready for the released
+    900k checkpoint)."""
+    torch = pytest.importorskip("torch")
+    import yaml
+
+    from speakingstyle_tpu.__main__ import main as cli_main
+    from speakingstyle_tpu.training.checkpoint import CheckpointManager
+    from speakingstyle_tpu.training.optim import make_optimizer
+    from speakingstyle_tpu.training.state import TrainState
+
+    np_sd = make_reference_state_dict()
+    sd = {k: torch.from_numpy(np.asarray(v)) for k, v in np_sd.items()}
+    ckpt_file = tmp_path / "900000.pth.tar"
+    torch.save({"model": sd, "optimizer": {}}, str(ckpt_file))
+
+    docs = {
+        "preprocess": {"path": {"preprocessed_path": synthetic_preprocessed}},
+        "model": {},
+        "train": {"path": {"ckpt_path": str(tmp_path / "ckpt"),
+                           "log_path": str(tmp_path / "log"),
+                           "result_path": str(tmp_path / "result")}},
+    }
+    paths = {}
+    for name, doc in docs.items():
+        p = tmp_path / f"{name}.yaml"
+        p.write_text(yaml.safe_dump(doc))
+        paths[name] = str(p)
+
+    cli_main(["convert", "-p", paths["preprocess"], "-m", paths["model"],
+              "-t", paths["train"], "--ckpt", str(ckpt_file),
+              "--eval_mel_l1"])
+
+    cfg = Config()
+    model = build_model(cfg)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    state = TrainState.create(variables, make_optimizer(cfg.train))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    assert mgr.latest_step() == 900000
+    restored = mgr.restore(state)
+    np.testing.assert_allclose(
+        np.asarray(restored.params["mel_linear"]["kernel"]),
+        np_sd["mel_linear.weight"].T,
+        rtol=1e-6,
+    )
+    mgr.close()

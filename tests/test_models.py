@@ -118,6 +118,7 @@ def test_padding_invariance(model_and_vars):
     )
 
 
+@pytest.mark.slow
 def test_jit_and_grad(model_and_vars):
     model, variables = model_and_vars
     texts, src_lens, mels, mel_lens, p, e, d = make_batch()
@@ -164,6 +165,7 @@ def test_multi_speaker_embedding():
     np.testing.assert_allclose(out_a["mel"][1], out_b["mel"][1], atol=1e-6)
 
 
+@pytest.mark.slow
 def test_remat_stack_runs():
     # regression: nn.remat static_argnums must point at `deterministic`
     import dataclasses
@@ -197,3 +199,73 @@ def test_loss_ignores_padded_frames(model_and_vars):
     mels_perturbed = mels.at[1, 12:].add(100.0)  # item 1 true mel_len is 12
     l2 = fastspeech2_loss(out, mels_perturbed, p, e, d, variables["params"])
     assert float(l1["mel_loss"]) == pytest.approx(float(l2["mel_loss"]))
+
+
+@pytest.mark.slow
+def test_conv_impls_identical_tree_and_outputs(model_and_vars):
+    """conv_impl xla/unfold/pallas: same param tree, same forward numbers
+    on the SAME params — checkpoints are impl-portable (ops/conv.py)."""
+    model, variables = model_and_vars
+    texts, src_lens, mels, mel_lens, p, e, d = make_batch()
+    kwargs = dict(
+        mels=mels, mel_lens=mel_lens, max_mel_len=18,
+        p_targets=p, e_targets=e, d_targets=d, deterministic=True,
+    )
+    speakers = jnp.zeros((2,), jnp.int32)
+
+    base_cfg = tiny_config()  # conv_impl="unfold" (ModelConfig default)
+    outs = {}
+    trees = {}
+    for impl in ("xla", "unfold", "pallas"):
+        cfg = dataclasses.replace(
+            base_cfg, model=dataclasses.replace(base_cfg.model, conv_impl=impl)
+        )
+        m = FastSpeech2(
+            config=cfg, pitch_stats=(-2, 8), energy_stats=(-1, 9)
+        )
+        init = m.init(
+            {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(0)},
+            speakers, texts, src_lens, **kwargs,
+        )
+        trees[impl] = jax.tree_util.tree_structure(init["params"])
+        outs[impl] = m.apply(variables, speakers, texts, src_lens, **kwargs)
+
+    assert trees["xla"] == trees["unfold"] == trees["pallas"]
+    for impl in ("unfold", "pallas"):
+        np.testing.assert_allclose(
+            np.asarray(outs[impl]["mel_postnet"]),
+            np.asarray(outs["xla"]["mel_postnet"]),
+            atol=2e-4,
+            err_msg=impl,
+        )
+
+
+def test_attention_softmax_dtype_bf16_close():
+    """attention_softmax_dtype="bfloat16" is an A/B knob: outputs stay
+    close to the f32-softmax reference path (same params)."""
+    cfg32 = tiny_config()
+    cfgbf = dataclasses.replace(
+        cfg32,
+        model=dataclasses.replace(
+            cfg32.model, attention_softmax_dtype="bfloat16"
+        ),
+    )
+    texts, src_lens, mels, mel_lens, p, e, d = make_batch()
+    speakers = jnp.zeros((2,), jnp.int32)
+    kwargs = dict(
+        mels=mels, mel_lens=mel_lens, max_mel_len=18,
+        p_targets=p, e_targets=e, d_targets=d, deterministic=True,
+    )
+    m32 = FastSpeech2(config=cfg32, pitch_stats=(-2, 8), energy_stats=(-1, 9))
+    mbf = FastSpeech2(config=cfgbf, pitch_stats=(-2, 8), energy_stats=(-1, 9))
+    variables = m32.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(0)},
+        speakers, texts, src_lens, **kwargs,
+    )
+    out32 = m32.apply(variables, speakers, texts, src_lens, **kwargs)
+    outbf = mbf.apply(variables, speakers, texts, src_lens, **kwargs)
+    np.testing.assert_allclose(
+        np.asarray(outbf["mel_postnet"]),
+        np.asarray(out32["mel_postnet"]),
+        atol=0.15,  # bf16 softmax rounding through 2+2 blocks
+    )

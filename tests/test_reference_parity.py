@@ -27,10 +27,13 @@ REF_DIR = "/root/reference"
 torch = pytest.importorskip("torch")
 yaml = pytest.importorskip("yaml")
 
-pytestmark = pytest.mark.skipif(
-    not os.path.isdir(os.path.join(REF_DIR, "model")),
-    reason="reference checkout not available",
-)
+pytestmark = [
+    pytest.mark.slow,
+    pytest.mark.skipif(
+        not os.path.isdir(os.path.join(REF_DIR, "model")),
+        reason="reference checkout not available",
+    ),
+]
 
 # Fixed batch geometry: unequal lengths to exercise masking.
 B, L_SRC, T_MEL = 2, 8, 16

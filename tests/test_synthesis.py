@@ -126,6 +126,7 @@ def test_pad_control():
     np.testing.assert_allclose(out, [[2, 3, 1, 1, 1]])
 
 
+@pytest.mark.slow
 def test_per_phone_duration_control_changes_length():
     """A [B, L] duration-control array must flow through the jitted forward
     and scale predicted durations per phone."""
@@ -196,6 +197,7 @@ def test_plot_mel_smoke():
     plt.close(fig)
 
 
+@pytest.mark.slow
 def test_get_vocoder_random_init_and_infer():
     from speakingstyle_tpu.synthesis import get_vocoder
     from speakingstyle_tpu.models.hifigan import vocoder_infer
@@ -208,6 +210,7 @@ def test_get_vocoder_random_init_and_infer():
     assert wavs[0].dtype == np.int16
 
 
+@pytest.mark.slow
 def test_synth_samples_griffin_lim(tmp_path, synthetic_preprocessed):
     """Vocoder-free path writes playable wavs + plots for every real item."""
     import jax
@@ -264,6 +267,7 @@ def test_cli_parsers_build():
         main(["train", "--help"])
 
 
+@pytest.mark.slow
 def test_cli_train_smoke(tmp_path, synthetic_preprocessed, monkeypatch):
     """python -m speakingstyle_tpu train on the synthetic dataset."""
     import yaml
@@ -305,6 +309,7 @@ def test_cli_train_smoke(tmp_path, synthetic_preprocessed, monkeypatch):
     assert "total_loss" in losses
 
 
+@pytest.mark.slow
 def test_trainer_default_synth_callback(tmp_path, synthetic_preprocessed):
     """run_training with synth_callback='default' renders a sample and logs
     throughput without error."""

@@ -56,6 +56,7 @@ def tiny_train_config(root, tmp_path, batch_size=8):
     return dataclasses.replace(cfg, preprocess=pp, model=mc, train=tr)
 
 
+@pytest.mark.slow
 def test_count_params_and_init(synthetic_preprocessed, tmp_path):
     cfg = tiny_train_config(synthetic_preprocessed, tmp_path)
     model = build_model(cfg)
@@ -65,6 +66,7 @@ def test_count_params_and_init(synthetic_preprocessed, tmp_path):
     assert "batch_stats" in variables
 
 
+@pytest.mark.slow
 def test_sharded_train_step_runs_and_descends(synthetic_preprocessed, tmp_path):
     cfg = tiny_train_config(synthetic_preprocessed, tmp_path)
     mesh = make_mesh()  # 8 virtual devices
@@ -95,6 +97,7 @@ def test_sharded_train_step_runs_and_descends(synthetic_preprocessed, tmp_path):
     assert losses_hist[-1] < losses_hist[0]
 
 
+@pytest.mark.slow
 def test_run_training_end_to_end_with_checkpoint(synthetic_preprocessed, tmp_path):
     cfg = tiny_train_config(synthetic_preprocessed, tmp_path)
     state = run_training(cfg, mesh=make_mesh(), max_steps=4, log=True)
@@ -151,6 +154,7 @@ def test_restore_ignore_layers(synthetic_preprocessed, tmp_path):
     ckpt.close()
 
 
+@pytest.mark.slow
 def test_train_step_bfloat16(synthetic_preprocessed, tmp_path):
     """The production compute dtype (bfloat16) compiles and descends on CPU.
 

@@ -19,6 +19,7 @@ from speakingstyle_tpu.models.hifigan_disc import (
 SEG = 2048  # short segments keep CPU tests fast
 
 
+@pytest.mark.slow
 def test_period_discriminator_shapes():
     mpd = MultiPeriodDiscriminator(periods=(2, 3))
     y = jnp.asarray(np.random.default_rng(0).standard_normal((2, SEG)), jnp.float32)
@@ -31,6 +32,7 @@ def test_period_discriminator_shapes():
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
 
 
+@pytest.mark.slow
 def test_scale_discriminator_shapes():
     msd = MultiScaleDiscriminator(n_scales=2)
     y = jnp.asarray(np.random.default_rng(0).standard_normal((2, SEG)), jnp.float32)
@@ -101,6 +103,7 @@ def test_mel_wav_dataset(tmp_path):
     assert mels.shape == (2, SEG // 256, 80)
 
 
+@pytest.mark.slow
 def test_vocoder_train_step_decreases_mel_l1(tmp_path):
     """A few GAN steps run end-to-end and produce finite, improving losses."""
     import scipy.io.wavfile
@@ -151,6 +154,7 @@ def test_vocoder_train_step_decreases_mel_l1(tmp_path):
     np.testing.assert_allclose(np.asarray(leaves1[0]), np.asarray(leaves2[0]))
 
 
+@pytest.mark.slow
 def test_vocoder_train_step_sharded():
     """The GAN step compiles and runs over an 8-device data mesh."""
     from speakingstyle_tpu.parallel.mesh import make_mesh
@@ -175,6 +179,7 @@ def test_vocoder_train_step_sharded():
     assert np.isfinite(float(metrics["gen_loss"]))
 
 
+@pytest.mark.slow
 def test_vocoder_optimizer_torch_adamw_weight_decay():
     """The GAN optimizers must use torch AdamW's default weight decay (0.01),
     not optax.adamw's 1e-4 (regression: silent recipe divergence). With zero
@@ -204,6 +209,7 @@ def test_vocoder_optimizer_torch_adamw_weight_decay():
     raise AssertionError("no nonzero parameter leaf found")
 
 
+@pytest.mark.slow
 def test_get_vocoder_rejects_full_state_msgpack(tmp_path):
     """Passing the trainer's primary vocoder_*.msgpack (a full VocoderState)
     to get_vocoder must fail with a pointer at the generator sidecar, not an
