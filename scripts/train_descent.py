@@ -32,10 +32,22 @@ def main():
     ap.add_argument("--resume_at", type=int, default=150)
     ap.add_argument("--device", default="cpu", choices=("cpu", "default"))
     ap.add_argument("--n_utts", type=int, default=640)
+    ap.add_argument("--conv_impl", default="xla",
+                    help="conv lowering for this run; the CPU demonstration "
+                    "defaults to 'xla' — the unfold/pallas lowerings are "
+                    "MXU-oriented and memory-hungry on a CPU host, and this "
+                    "artifact is about training dynamics, not conv speed")
     args = ap.parse_args()
 
+    if args.device == "cpu" and os.environ.get("PALLAS_AXON_POOL_IPS"):
+        # The tunneled-TPU (axon) plugin registers at interpreter startup
+        # via sitecustomize — mutating the env here is too late and backend
+        # init then hangs on a sick tunnel. Re-exec with a clean env.
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        os.execve(sys.executable, [sys.executable] + sys.argv, env)
     if args.device == "cpu":
-        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
         os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax
@@ -60,11 +72,23 @@ def main():
     corpus = tempfile.mkdtemp(prefix="synth_corpus_")
     print(f"generating {args.n_utts}-utterance synthetic corpus in {corpus}",
           flush=True)
-    generate_corpus(corpus, n_utts=args.n_utts)
+    # Narrow length ranges so every batch lands in ONE (src=128, mel=640)
+    # bucket: exactly one train-step compile (paper geometry, ~600
+    # frames/utt), which keeps the CPU demonstration tractable and the
+    # throughput line comparable across steps.
+    generate_corpus(
+        corpus,
+        n_utts=args.n_utts,
+        n_phones_per_utt=(97, 104),
+        duration_range=(5, 7),
+    )
 
     out = os.path.abspath(args.out)
     os.makedirs(out, exist_ok=True)
-    cfg = Config(train=TrainConfig(
+    from speakingstyle_tpu.configs.config import ModelConfig
+
+    cfg = Config(model=ModelConfig(conv_impl=args.conv_impl),
+                 train=TrainConfig(
         path=TrainPathConfig(
             ckpt_path=os.path.join(out, "ckpt"),
             log_path=out,

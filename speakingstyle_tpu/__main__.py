@@ -12,6 +12,7 @@ COMMANDS = (
     "train_vocoder",
     "vocode",
     "convert",
+    "analyze",
 )
 
 

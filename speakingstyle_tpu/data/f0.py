@@ -32,7 +32,14 @@ def extract_f0(
     f0_floor: float = 71.0,
     f0_ceil: float = 800.0,
 ) -> np.ndarray:
-    """wav [T] float in [-1,1] -> f0 [n_frames] Hz, 0 where unvoiced."""
+    """wav [T] float in [-1,1] -> f0 [n_frames] Hz, 0 where unvoiced.
+
+    Backend chain: pyworld (reference parity when installed) -> the
+    framework's own C++ YIN (speakingstyle_tpu/native, compiled on first
+    use) -> the vectorized numpy YIN below. The two YIN backends implement
+    the identical algorithm (tests/test_preprocessor.py asserts
+    near-bitwise agreement).
+    """
     try:
         import pyworld as pw  # optional native backend
 
@@ -43,7 +50,15 @@ def extract_f0(
         )
         return pw.stonemask(wav.astype(np.float64), f0, t, sampling_rate)
     except ImportError:
-        return yin_f0(wav, sampling_rate, hop_length, f0_floor, f0_ceil)
+        pass
+    from speakingstyle_tpu.native import yin_f0_native
+
+    native = yin_f0_native(
+        wav, sampling_rate, hop_length, f0_floor, f0_ceil
+    )
+    if native is not None:
+        return native
+    return yin_f0(wav, sampling_rate, hop_length, f0_floor, f0_ceil)
 
 
 def _difference_function(frames: np.ndarray, max_lag: int) -> np.ndarray:

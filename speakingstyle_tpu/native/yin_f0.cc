@@ -1,0 +1,135 @@
+// Native YIN pitch tracker — the framework's own replacement for the
+// reference's one native dependency (pyworld's C++ WORLD bindings, used
+// only for F0 extraction: reference preprocessor/preprocessor.py:182-187).
+//
+// Algorithm and constants mirror speakingstyle_tpu/data/f0.py::yin_f0
+// EXACTLY (same difference function, cumulative-mean normalization,
+// first-dip-run selection, parabolic interpolation, voicing rule), in
+// double precision, so the Python test suite can assert near-bitwise
+// agreement between the two backends. Direct O(W·maxlag) correlation per
+// frame: at 22.05 kHz (W≈620, maxlag≈312) that is ~0.2 MFLOP per 11.6 ms
+// hop — orders of magnitude faster than real time without needing an FFT.
+//
+// Build (see speakingstyle_tpu/native/__init__.py::ensure_built):
+//   g++ -O3 -march=native -shared -fPIC -o libyin_f0.so yin_f0.cc
+//
+// C ABI only — consumed via ctypes, no pybind11 dependency.
+
+#include <cmath>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// wav[n] float64 in [-1, 1] -> out[n_frames] Hz (0 where unvoiced).
+// Returns the number of frames written (n/hop + 1), or -1 on bad args.
+long yin_f0(const double* wav, long n, double sampling_rate, long hop_length,
+            double f0_floor, double f0_ceil, double threshold,
+            long frame_length, double* out) {
+  if (n <= 0 || hop_length <= 0 || f0_floor <= 0 || f0_ceil <= f0_floor)
+    return -1;
+  const long max_lag = (long)(sampling_rate / f0_floor) + 2;
+  long min_lag = (long)(sampling_rate / f0_ceil);
+  if (min_lag < 2) min_lag = 2;
+  const long w = frame_length > 0 ? frame_length : 2 * max_lag;
+  if (w <= max_lag) return -1;
+
+  const long n_frames = n / hop_length + 1;
+  const long pad_front = w / 2;  // matches np.pad(wav, (w//2, w))
+  const long padded_len = pad_front + n + w;
+
+  std::vector<double> padded((size_t)padded_len, 0.0);
+  std::memcpy(padded.data() + pad_front, wav, sizeof(double) * (size_t)n);
+
+  std::vector<double> frame((size_t)w);
+  std::vector<double> d((size_t)max_lag);
+  std::vector<double> cmnd((size_t)max_lag);
+
+  for (long t = 0; t < n_frames; ++t) {
+    const double* src = padded.data() + t * hop_length;
+    double mean = 0.0;
+    for (long j = 0; j < w; ++j) mean += src[j];
+    mean /= (double)w;
+    double energy_sq = 0.0;
+    for (long j = 0; j < w; ++j) {
+      frame[(size_t)j] = src[j] - mean;
+      energy_sq += frame[(size_t)j] * frame[(size_t)j];
+    }
+    const double energy = std::sqrt(energy_sq / (double)w);
+
+    // d(tau) = e_head(tau) + e_tail(tau) - 2*acf(tau); e_head over
+    // x[0:w-tau], e_tail over x[tau:w] (same decomposition as f0.py).
+    // Running the head/tail energies incrementally keeps this O(W) per
+    // tau for the energies + O(W) for the correlation.
+    double e_head = energy_sq;  // tau = 0: full window
+    double e_tail = energy_sq;
+    d[0] = 0.0;
+    for (long tau = 1; tau < max_lag; ++tau) {
+      e_head -= frame[(size_t)(w - tau)] * frame[(size_t)(w - tau)];
+      e_tail -= frame[(size_t)(tau - 1)] * frame[(size_t)(tau - 1)];
+      double acf = 0.0;
+      const double* a = frame.data();
+      const double* b = frame.data() + tau;
+      const long m = w - tau;
+      for (long j = 0; j < m; ++j) acf += a[j] * b[j];
+      d[(size_t)tau] = e_head + e_tail - 2.0 * acf;
+    }
+
+    // cumulative mean normalized difference
+    cmnd[0] = 1.0;
+    double dsum = 0.0;
+    for (long tau = 1; tau < max_lag; ++tau) {
+      dsum += d[(size_t)tau];
+      const double denom = dsum > 1e-12 ? dsum : 1e-12;
+      cmnd[(size_t)tau] = d[(size_t)tau] * (double)tau / denom;
+    }
+
+    // first below-threshold dip: argmin over its contiguous run
+    const long rlen = max_lag - min_lag;
+    long first = -1;
+    for (long i = 0; i < rlen; ++i) {
+      if (cmnd[(size_t)(min_lag + i)] < threshold) { first = i; break; }
+    }
+    long best_i;
+    if (first >= 0) {
+      long end = first;
+      while (end < rlen && cmnd[(size_t)(min_lag + end)] < threshold) ++end;
+      best_i = first;
+      for (long i = first; i < end; ++i)
+        if (cmnd[(size_t)(min_lag + i)] < cmnd[(size_t)(min_lag + best_i)])
+          best_i = i;
+    } else {
+      best_i = 0;
+      for (long i = 1; i < rlen; ++i)
+        if (cmnd[(size_t)(min_lag + i)] < cmnd[(size_t)(min_lag + best_i)])
+          best_i = i;
+    }
+    long best = best_i + min_lag;
+
+    // parabolic interpolation around the chosen lag
+    long b_ = best;
+    if (b_ < 1) b_ = 1;
+    if (b_ > max_lag - 2) b_ = max_lag - 2;
+    const double y0 = cmnd[(size_t)(b_ - 1)];
+    const double y1 = cmnd[(size_t)b_];
+    const double y2 = cmnd[(size_t)(b_ + 1)];
+    const double denom2 = y0 - 2.0 * y1 + y2;
+    double offset = 0.0;
+    if (std::fabs(denom2) > 1e-12) {
+      offset = (y0 - y2) / (2.0 * denom2);
+      if (offset < -1.0) offset = -1.0;
+      if (offset > 1.0) offset = 1.0;
+    }
+    const double lag = (double)b_ + offset;
+    const double f0 = sampling_rate / (lag > 1e-6 ? lag : 1e-6);
+    const double dip_depth = y1;
+
+    const bool voiced = dip_depth < 2.0 * threshold && energy > 1e-4 &&
+                        f0 >= f0_floor && f0 <= f0_ceil;
+    out[t] = voiced ? f0 : 0.0;
+  }
+  return n_frames;
+}
+
+}  // extern "C"

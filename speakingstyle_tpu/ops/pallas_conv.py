@@ -157,6 +157,13 @@ def _use_interpret() -> bool:
 )
 def _fused(x, kernel, bias, ln_scale, ln_bias, dilation, relu, tile,
            interpret):
+    if not _HAVE_PLTPU:
+        # no pallas-TPU module at all (even the interpreter path uses its
+        # DMA/scratch primitives) — run the mathematically identical
+        # reference implementation instead of failing later
+        return _reference_fused(
+            x, kernel, bias, ln_scale, ln_bias, dilation, relu
+        )
     return _fused_fwd_pallas(
         x, kernel, bias, ln_scale, ln_bias, dilation, relu, tile, interpret
     )

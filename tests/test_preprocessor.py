@@ -503,3 +503,43 @@ def test_normalize_dir_empty_written_is_finite(tmp_path):
     vmin, vmax = Preprocessor(cfg)._normalize_dir("pitch", 0.0, 1.0, [])
     assert np.isfinite(vmin) and np.isfinite(vmax)
     json.dumps({"pitch": [vmin, vmax]})  # must not raise / emit Infinity
+
+
+def test_native_yin_matches_numpy():
+    """The C++ YIN (speakingstyle_tpu/native) is an exact port of the
+    numpy tracker: identical voiced mask, |Δf0| at float-noise level."""
+    from speakingstyle_tpu.native import have_native_yin, yin_f0_native
+
+    if not have_native_yin():
+        pytest.skip("no C++ compiler available")
+    rng = np.random.default_rng(0)
+    t = np.arange(2 * SR) / SR
+    f_inst = 150.0 * (1 + 0.05 * np.sin(2 * np.pi * 3 * t))
+    wav = 0.4 * np.sin(2 * np.pi * np.cumsum(f_inst) / SR)
+    wav += 0.002 * rng.standard_normal(len(t))
+
+    a = yin_f0(wav, SR, HOP)
+    b = yin_f0_native(wav, SR, HOP)
+    assert a.shape == b.shape
+    np.testing.assert_array_equal(a > 0, b > 0)
+    both = (a > 0) & (b > 0)
+    assert np.abs(a[both] - b[both]).max() < 1e-6
+
+    # silence/noise paths agree too
+    np.testing.assert_array_equal(
+        yin_f0_native(np.zeros(SR), SR, HOP) > 0, np.zeros(SR // HOP + 1, bool)
+    )
+
+
+def test_extract_f0_backend_chain():
+    """extract_f0 without pyworld lands on the native (or numpy) YIN and
+    keeps the contract: len(wav)//hop + 1 frames, zeros on unvoiced."""
+    from speakingstyle_tpu.data.f0 import extract_f0
+
+    t = np.arange(SR) / SR
+    wav = 0.4 * np.sin(2 * np.pi * 220.0 * t)
+    f0 = extract_f0(wav, SR, HOP)
+    assert len(f0) == SR // HOP + 1
+    voiced = f0[f0 > 0]
+    assert len(voiced) > 0.8 * len(f0)
+    assert np.median(voiced) == pytest.approx(220.0, rel=0.02)

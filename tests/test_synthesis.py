@@ -337,3 +337,56 @@ def test_trainer_default_synth_callback(tmp_path, synthetic_preprocessed):
     assert int(state.step) == 2
     log = (tmp_path / "log" / "log.txt").read_text()
     assert "[perf] Step" in log and "mel-frames/s" in log
+
+
+@pytest.mark.slow
+def test_cli_analyze_all_modes(tmp_path):
+    """`analyze` productizes the reference's variance-distribution and
+    ref-encoder notebooks: features, predictions (free-running), style."""
+    import json as _json
+
+    import yaml
+
+    from speakingstyle_tpu.__main__ import main
+    from speakingstyle_tpu.data.synthetic import generate_corpus
+
+    corpus = str(tmp_path / "corpus")
+    generate_corpus(corpus, n_utts=18, val_utts=5,
+                    n_phones_per_utt=(8, 12), duration_range=(2, 4))
+    docs = {
+        "preprocess": {"path": {"preprocessed_path": corpus}},
+        "model": {"transformer": {"encoder_layer": 1, "decoder_layer": 1,
+                                  "encoder_hidden": 32, "decoder_hidden": 32,
+                                  "conv_filter_size": 64},
+                  "reference_encoder": {"encoder_layer": 1,
+                                        "encoder_hidden": 32,
+                                        "conv_filter_size": 64},
+                  "variance_predictor": {"filter_size": 32},
+                  "variance_embedding": {"n_bins": 16},
+                  "max_seq_len": 96},
+        "train": {"path": {"ckpt_path": str(tmp_path / "ckpt"),
+                           "log_path": str(tmp_path / "log"),
+                           "result_path": str(tmp_path / "res")}},
+    }
+    cargs = []
+    for name, doc in docs.items():
+        p = tmp_path / f"{name}.yaml"
+        p.write_text(yaml.safe_dump(doc))
+        cargs += [{"preprocess": "-p", "model": "-m", "train": "-t"}[name],
+                  str(p)]
+
+    feats = main(["analyze", *cargs, "--what", "features"])
+    assert feats["pitch"]["count"] > 0 and feats["duration"]["count"] > 0
+
+    preds = main(["analyze", *cargs, "--what", "predictions",
+                  "--max_batches", "2"])
+    assert preds["pitch"]["pred"]["count"] > 0
+
+    out_json = str(tmp_path / "style.json")
+    style = main(["analyze", *cargs, "--what", "style", "--max_batches", "2",
+                  "--json", out_json])
+    assert style["n_utts"] > 0
+    gates = style["film_gates"]
+    assert any(k.endswith("s_gamma") for k in gates)
+    assert any(k.endswith("s_beta") for k in gates)
+    assert _json.load(open(out_json))["what"] == "style"
