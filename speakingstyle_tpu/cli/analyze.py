@@ -83,27 +83,35 @@ def _remove_outlier(values, k=3.0):
     return values[keep]
 
 
-def _split_basenames(cfg, split):
+def _split_entries(cfg, split):
+    """[(basename, speaker)] from the metadata file — the one canonical
+    source of feature-file names (data/dataset.py's
+    ``{speaker}-{kind}-{basename}.npy`` convention)."""
     root = cfg.preprocess.path.preprocessed_path
+    entries = []
     with open(os.path.join(root, split)) as f:
-        return {ln.split("|")[0] for ln in f if ln.strip()}, root
+        for ln in f:
+            if not ln.strip():
+                continue
+            parts = ln.split("|")
+            entries.append((parts[0], parts[1]))
+    return entries, root
 
 
 def _corpus_features(cfg, split, denormalize=True):
     """``denormalize=False`` keeps pitch/energy in the on-disk z-normalized
     space — required when comparing against model predictions, which live
     there too."""
-    basenames, root = _split_basenames(cfg, split)
+    entries, root = _split_entries(cfg, split)
     with open(os.path.join(root, "stats.json")) as f:
         stats = json.load(f)
     out = {"pitch": [], "energy": [], "duration": []}
     for kind in out:
-        d = os.path.join(root, kind)
-        for fn in os.listdir(d):
-            base = "-".join(fn.split(".")[0].split("-")[2:])
-            if base not in basenames:
+        for base, spk in entries:
+            path = os.path.join(root, kind, f"{spk}-{kind}-{base}.npy")
+            if not os.path.exists(path):
                 continue
-            v = np.load(os.path.join(d, fn)).astype(np.float64)
+            v = np.load(path).astype(np.float64)
             if (
                 denormalize
                 and kind in ("pitch", "energy")
@@ -174,15 +182,24 @@ def _predictions(cfg, split, restore_step, max_batches):
             deterministic=True,
         )
 
+    # pitch/energy predictions are phoneme- or frame-shaped depending on
+    # the corpus config (configs/config.py feature levels) — pick the
+    # matching pad mask for each
+    p_level = cfg.preprocess.preprocessing.pitch.feature
+    e_level = cfg.preprocess.preprocessing.energy.feature
+
     pitch, energy, durations = [], [], []
     for n, batch in enumerate(batcher.epoch(shuffle=False)):
         if n >= max_batches:
             break
         out = fwd(state.params, state.batch_stats, batch.arrays())
-        keep = ~np.asarray(out["src_pad_mask"])
-        pitch.extend(np.asarray(out["pitch_prediction"])[keep].tolist())
-        energy.extend(np.asarray(out["energy_prediction"])[keep].tolist())
-        durations.extend(np.asarray(out["durations"])[keep].tolist())
+        keep_src = ~np.asarray(out["src_pad_mask"])
+        keep_mel = ~np.asarray(out["mel_pad_mask"])
+        keep_p = keep_src if p_level == "phoneme_level" else keep_mel
+        keep_e = keep_src if e_level == "phoneme_level" else keep_mel
+        pitch.extend(np.asarray(out["pitch_prediction"])[keep_p].tolist())
+        energy.extend(np.asarray(out["energy_prediction"])[keep_e].tolist())
+        durations.extend(np.asarray(out["durations"])[keep_src].tolist())
     return pitch, energy, durations
 
 
@@ -206,27 +223,42 @@ def _style(cfg, split, restore_step, max_batches):
         ds, max_src=cfg.model.max_seq_len, max_mel=cfg.model.max_seq_len
     )
 
+    # only the style branch is needed — apply the ReferenceEncoder
+    # submodule directly on its params subtree (same construction as
+    # models/fastspeech2.py), jitted, instead of the whole acoustic model
+    import jax
+    import jax.numpy as jnp
+
+    from speakingstyle_tpu.models.reference_encoder import ReferenceEncoder
+    from speakingstyle_tpu.ops.masking import length_to_mask
+
+    ref = cfg.model.reference_encoder
+    enc = ReferenceEncoder(
+        n_conv_layers=ref.conv_layer,
+        conv_filter_size=ref.conv_filter_size,
+        conv_kernel_size=ref.conv_kernel_size,
+        n_layers=ref.encoder_layer,
+        n_head=ref.encoder_head,
+        d_model=ref.encoder_hidden,
+        dropout=ref.dropout,
+        n_position=cfg.model.max_seq_len + 1,
+        conv_impl=cfg.model.conv_impl,
+        dtype=jnp.dtype(cfg.model.compute_dtype),
+        softmax_dtype=jnp.dtype(cfg.model.attention_softmax_dtype),
+    )
+
+    @jax.jit
+    def style_fwd(ref_params, mels, mel_lens):
+        pad = length_to_mask(mel_lens, mels.shape[1])
+        return enc.apply({"params": ref_params}, mels, pad, deterministic=True)
+
+    ref_params = state.params["reference_encoder"]
     gammas_all, betas_all = [], []
     for n, batch in enumerate(batcher.epoch(shuffle=False)):
         if n >= max_batches:
             break
         arrays = batch.arrays()
-        out = model.apply(
-            {"params": state.params, "batch_stats": state.batch_stats},
-            speakers=arrays["speakers"],
-            texts=arrays["texts"],
-            src_lens=arrays["src_lens"],
-            mels=arrays["mels"],
-            mel_lens=arrays["mel_lens"],
-            max_mel_len=arrays["mels"].shape[1],
-            p_targets=arrays.get("pitches"),
-            e_targets=arrays.get("energies"),
-            d_targets=arrays.get("durations"),
-            deterministic=True,
-            capture_intermediates=lambda mdl, _: mdl.name == "reference_encoder",
-        )
-        inter = out[1]["intermediates"]["reference_encoder"]["__call__"][0]
-        g, b = inter
+        g, b = style_fwd(ref_params, arrays["mels"], arrays["mel_lens"])
         gammas_all.append(np.asarray(g)[:, 0, :])
         betas_all.append(np.asarray(b)[:, 0, :])
     gammas = np.concatenate(gammas_all) if gammas_all else np.zeros((0, 1))

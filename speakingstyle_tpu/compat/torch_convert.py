@@ -235,3 +235,51 @@ def convert_hifigan(sd: Dict[str, np.ndarray]) -> Dict:
                 j += 1
         params[f"resblocks_{n}"] = block
     return params
+
+
+def convert_melgan(sd: Dict[str, np.ndarray]) -> Dict:
+    """descript MelGAN generator state_dict -> params for models/melgan.py.
+
+    The hub module is one big ``nn.Sequential`` named ``model`` (reference
+    usage: utils/model.py:64-74; architecture: descriptinc/melgan-neurips
+    mel2wav/modules.py), so keys are positional: ``model.<i>.weight`` for
+    the plain convs / transposed convs and ``model.<i>.{block.2,block.4,
+    shortcut}.weight`` inside ResnetBlocks. Conversion walks the indices in
+    order and classifies by position: first plain conv = conv_pre, then
+    per upsample stage one transposed conv + n residual blocks, final
+    plain conv = conv_post. Weight norm is folded first.
+    """
+    sd = {k.removeprefix("mel2wav."): v for k, v in sd.items()}
+    sd = fold_weight_norm(sd)
+
+    idxs = sorted(
+        {int(k.split(".")[1]) for k in sd if k.startswith("model.")}
+    )
+    plain = [i for i in idxs if f"model.{i}.weight" in sd]
+    res = [i for i in idxs if f"model.{i}.block.2.weight" in sd]
+    if len(plain) < 3:
+        raise ValueError("not a MelGAN generator state_dict")
+
+    def _reflect_conv(i):
+        return {"conv": _conv1d(sd, f"model.{i}")}
+
+    params: Dict = {"conv_pre": _reflect_conv(plain[0]),
+                    "conv_post": _reflect_conv(plain[-1])}
+
+    ups = plain[1:-1]  # transposed convs, in encounter order
+    n_res_per_stage = len(res) // max(len(ups), 1)
+    for s, i in enumerate(ups):
+        # torch ConvTranspose1d weight [in, out, k] passes through
+        # untransposed (TorchConvTranspose1d stores torch's native layout)
+        params[f"ups_{s}"] = {
+            "kernel": sd[f"model.{i}.weight"],
+            "bias": sd[f"model.{i}.bias"],
+        }
+    for n, i in enumerate(res):
+        s, j = divmod(n, n_res_per_stage)
+        params[f"res_{s}_{j}"] = {
+            "conv1": {"conv": _conv1d(sd, f"model.{i}.block.2")},
+            "conv2": {"conv": _conv1d(sd, f"model.{i}.block.4")},
+            "shortcut": {"conv": _conv1d(sd, f"model.{i}.shortcut")},
+        }
+    return params

@@ -147,6 +147,16 @@ class Generator(nn.Module):
         x = TorchConv1d(1, 7, dtype=self.dtype, name="conv_post")(x)
         return jnp.tanh(x)[..., 0].astype(jnp.float32)
 
+    # -- uniform vocoder interface (vocoder_infer is family-agnostic) --
+
+    @property
+    def hop_factor(self) -> int:
+        return int(np.prod(self.upsample_rates))
+
+    def vocode(self, params, mels):
+        """mels in the acoustic model's natural-log space -> wav."""
+        return self.apply({"params": params}, mels)
+
 
 def generator_from_config(config: dict, dtype=jnp.float32) -> Generator:
     """Build from a hifigan config.json dict (reference: hifigan/config.json)."""
@@ -172,13 +182,15 @@ def generator_from_config(config: dict, dtype=jnp.float32) -> Generator:
 def vocoder_infer(generator, params, mels, lengths=None, max_wav_value=32768.0):
     """Batch mel [B, T, n_mels] -> list of int16 wavs trimmed to true
     lengths (reference: utils/model.py:97-115, which scales by
-    max_wav_value and casts to int16)."""
-    wavs = generator.apply({"params": params}, mels)
+    max_wav_value and casts to int16). Family-agnostic: every vocoder
+    generator exposes ``vocode(params, mels)`` (which owns any input
+    convention, e.g. MelGAN's log10 scaling) and ``hop_factor``."""
+    wavs = generator.vocode(params, mels)
+    hop_factor = generator.hop_factor
     wavs = np.clip(
         np.asarray(wavs) * max_wav_value, -max_wav_value, max_wav_value - 1
     ).astype(np.int16)
     out = []
-    hop_factor = int(np.prod(generator.upsample_rates))
     for i in range(wavs.shape[0]):
         n = wavs.shape[1] if lengths is None else int(lengths[i]) * hop_factor
         out.append(wavs[i, :n])

@@ -1,0 +1,119 @@
+"""MelGAN generator in Flax (the reference's alternative vocoder).
+
+The reference loads this from torch.hub at runtime
+(reference: utils/model.py:64-74 — ``descriptinc/melgan-neurips``
+``load_melgan`` with the "linda_johnson" / "multi_speaker" checkpoints)
+and feeds it **log10** mels: ``vocoder.inverse(mels / np.log(10))``
+(reference: utils/model.py:101-102).
+
+Architecture per the public descript implementation (MelGAN, Kumar et al.
+2019; mel2wav/modules.py): reflection-padded conv k=7 → 4× [LeakyReLU(0.2)
+→ weight-norm ConvTranspose1d(k=2r, stride=r) → n_residual dilated
+ResnetBlocks (dilations 3^j, reflection padding, 1×1 shortcut)] →
+LeakyReLU → reflection-padded conv k=7 → tanh. Hub checkpoints use
+ngf=32, 3 residual layers, ratios (8,8,2,2) ⇒ 256× upsampling.
+
+Weights load through ``compat.torch_convert.convert_melgan`` (weight norm
+folded); the torch.hub download itself must happen on a machine with
+network access — pass the saved state-dict file to ``get_vocoder``.
+Numerical parity with a torch replica of the descript stack is pinned by
+tests/test_hifigan.py::test_melgan_torch_parity.
+"""
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+from speakingstyle_tpu.models.hifigan import TorchConvTranspose1d
+
+MELGAN_LRELU_SLOPE = 0.2
+LOG10 = float(np.log(10.0))
+
+
+class ReflectConv1d(nn.Module):
+    """Reflection-padded conv1d (descript's ReflectionPad1d + WNConv1d
+    pair, weight norm folded at conversion)."""
+
+    features: int
+    kernel_size: int
+    dilation: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        pad = self.dilation * (self.kernel_size - 1) // 2
+        if pad:
+            x = jnp.pad(x, ((0, 0), (pad, pad), (0, 0)), mode="reflect")
+        return nn.Conv(
+            self.features,
+            kernel_size=(self.kernel_size,),
+            kernel_dilation=(self.dilation,),
+            padding="VALID",
+            dtype=self.dtype,
+            name="conv",
+        )(x)
+
+
+class MelGANResBlock(nn.Module):
+    """descript ResnetBlock: LeakyReLU → dilated k=3 conv → LeakyReLU →
+    1×1 conv, plus a 1×1 shortcut."""
+
+    dim: int
+    dilation: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.leaky_relu(x, MELGAN_LRELU_SLOPE)
+        y = ReflectConv1d(
+            self.dim, 3, dilation=self.dilation, dtype=self.dtype, name="conv1"
+        )(y)
+        y = nn.leaky_relu(y, MELGAN_LRELU_SLOPE)
+        y = ReflectConv1d(self.dim, 1, dtype=self.dtype, name="conv2")(y)
+        s = ReflectConv1d(self.dim, 1, dtype=self.dtype, name="shortcut")(x)
+        return s + y
+
+
+class MelGANGenerator(nn.Module):
+    """log10-mel [B, T, n_mels] -> wav [B, T * prod(ratios)]."""
+
+    n_mels: int = 80
+    ngf: int = 32
+    n_residual_layers: int = 3
+    ratios: Sequence[int] = (8, 8, 2, 2)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, mel):
+        mult = 2 ** len(self.ratios)
+        x = ReflectConv1d(
+            mult * self.ngf, 7, dtype=self.dtype, name="conv_pre"
+        )(mel)
+        for i, r in enumerate(self.ratios):
+            ch = mult * self.ngf // 2
+            x = nn.leaky_relu(x, MELGAN_LRELU_SLOPE)
+            x = TorchConvTranspose1d(
+                ch, 2 * r, r, dtype=self.dtype, name=f"ups_{i}"
+            )(x)
+            for j in range(self.n_residual_layers):
+                x = MelGANResBlock(
+                    ch, 3**j, dtype=self.dtype, name=f"res_{i}_{j}"
+                )(x)
+            mult //= 2
+        x = nn.leaky_relu(x, MELGAN_LRELU_SLOPE)
+        x = ReflectConv1d(1, 7, dtype=self.dtype, name="conv_post")(x)
+        return jnp.tanh(x)[..., 0].astype(jnp.float32)
+
+    # -- uniform vocoder interface (hifigan.vocoder_infer) --
+
+    @property
+    def hop_factor(self) -> int:
+        return int(np.prod(self.ratios))
+
+    def vocode(self, params, mels):
+        """The reference's calling convention: the acoustic model emits
+        natural-log mels; MelGAN was trained on log10, so scale by 1/ln10
+        (reference: utils/model.py:101-102)."""
+        return self.apply({"params": params}, mels / LOG10)

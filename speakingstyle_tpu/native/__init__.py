@@ -35,10 +35,16 @@ def ensure_built(force: bool = False) -> Optional[str]:
     path, or None when unavailable (no compiler / build error)."""
     global _build_failed
     with _lock:
-        if not force and os.path.exists(_LIB) and os.path.getmtime(
-            _LIB
-        ) >= os.path.getmtime(_SRC):
+        try:
+            fresh = os.path.exists(_LIB) and os.path.getmtime(
+                _LIB
+            ) >= os.path.getmtime(_SRC)
+        except OSError:  # source missing: use the prebuilt lib if present
+            fresh = os.path.exists(_LIB)
+        if not force and fresh:
             return _LIB
+        if not os.path.exists(_SRC):
+            return _LIB if os.path.exists(_LIB) else None
         if _build_failed and not force:
             return None
         # Compile to a process-unique temp path then os.rename (atomic on

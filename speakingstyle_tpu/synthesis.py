@@ -49,10 +49,11 @@ def get_vocoder(
     Reference: utils/model.py:62-94.
     """
     name = cfg.model.vocoder.model
+    if name in ("MelGAN", "melgan"):
+        return _get_melgan(cfg, ckpt_path, rng)
     if name not in ("HiFi-GAN", "hifigan"):
         raise NotImplementedError(
-            f"vocoder {name!r}: only HiFi-GAN is supported on TPU "
-            "(the reference's MelGAN path pulls torch.hub weights); "
+            f"vocoder {name!r}: HiFi-GAN and MelGAN are supported; "
             "use synthesize --griffin_lim for a vocoder-free fallback"
         )
     hcfg = dict(DEFAULT_HIFIGAN_CONFIG)
@@ -103,6 +104,48 @@ def get_vocoder(
 
         rng = rng if rng is not None else jax.random.PRNGKey(0)
         n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+        params = gen.init(rng, np.zeros((1, 16, n_mels), np.float32))["params"]
+    return gen, params
+
+
+def _get_melgan(cfg: Config, ckpt_path: Optional[str], rng=None):
+    """MelGAN generator + params (reference: utils/model.py:64-74, which
+    pulls descriptinc/melgan-neurips from torch.hub at runtime).
+
+    ``ckpt_path`` is a locally saved hub state-dict file (this framework
+    never fetches the network at runtime) or a *.msgpack params file;
+    without one, the generator is randomly initialized (tests /
+    architecture checks).
+    """
+    import jax
+
+    from speakingstyle_tpu.models.melgan import MelGANGenerator
+
+    n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+    gen = MelGANGenerator(n_mels=n_mels)
+    if ckpt_path and ckpt_path.endswith(".msgpack"):
+        from flax import serialization
+
+        init = gen.init(
+            jax.random.PRNGKey(0), np.zeros((1, 16, n_mels), np.float32)
+        )["params"]
+        with open(ckpt_path, "rb") as f:
+            params = serialization.from_bytes(init, f.read())
+    elif ckpt_path:
+        import torch
+
+        from speakingstyle_tpu.compat.torch_convert import convert_melgan
+
+        obj = torch.load(ckpt_path, map_location="cpu", weights_only=True)
+        # hub checkpoints are either the raw generator state_dict or a
+        # wrapper with it under a conventional key
+        for key in ("model_g", "generator", "netG", "state_dict"):
+            if isinstance(obj, dict) and key in obj:
+                obj = obj[key]
+        sd = {k: v.detach().cpu().numpy() for k, v in obj.items()}
+        params = convert_melgan(sd)
+    else:
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
         params = gen.init(rng, np.zeros((1, 16, n_mels), np.float32))["params"]
     return gen, params
 

@@ -192,3 +192,61 @@ def test_train_step_bfloat16(synthetic_preprocessed, tmp_path):
         if first is None:
             first = total
     assert total < first  # descends under bf16 too
+
+
+@pytest.mark.slow
+def test_training_descends_on_learnable_synthetic_corpus(tmp_path):
+    """Short replay of the committed descent artifact
+    (artifacts/train_descent_r4, scripts/train_descent.py): on the
+    learnable synthetic corpus (data/synthetic.py) the real run_training
+    loop must drive the loss clearly below its early value, across a
+    checkpoint+resume boundary."""
+    import dataclasses
+
+    from speakingstyle_tpu.configs.config import (
+        OptimizerConfig,
+        StepConfig,
+        TrainConfig,
+        TrainPathConfig,
+    )
+    from speakingstyle_tpu.data.synthetic import generate_corpus
+    from speakingstyle_tpu.training.trainer import run_training
+
+    corpus = str(tmp_path / "corpus")
+    generate_corpus(corpus, n_utts=40, val_utts=4,
+                    n_phones_per_utt=(10, 14), duration_range=(2, 4))
+
+    cfg = tiny_config()
+    cfg = dataclasses.replace(
+        cfg,
+        preprocess=dataclasses.replace(
+            cfg.preprocess,
+            path=dataclasses.replace(
+                cfg.preprocess.path, preprocessed_path=corpus
+            ),
+        ),
+        train=TrainConfig(
+            path=TrainPathConfig(
+                ckpt_path=str(tmp_path / "ckpt"),
+                log_path=str(tmp_path / "log"),
+                result_path=str(tmp_path / "res"),
+            ),
+            optimizer=OptimizerConfig(batch_size=8),
+            step=StepConfig(total_step=40, log_step=5, val_step=1000,
+                            save_step=20, synth_step=10**9),
+        ),
+    )
+    run_training(cfg, max_steps=20)
+    run_training(cfg, restore_step=-1, max_steps=40)
+
+    log = (tmp_path / "log" / "log.txt").read_text().splitlines()
+    losses = {}
+    for ln in log:
+        # format: "[train] Step N, total_loss: X, mel_loss: ..., lr: ..."
+        if ln.startswith("[train] Step ") and "total_loss:" in ln:
+            step = int(ln.split("Step ")[1].split(",")[0])
+            losses[step] = float(ln.split("total_loss: ")[1].split(",")[0])
+    assert 5 in losses and 40 in losses, sorted(losses)
+    early = losses[5]
+    late = min(losses[s] for s in losses if s > 30)
+    assert late < 0.7 * early, (early, late, losses)
