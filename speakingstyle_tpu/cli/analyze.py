@@ -227,25 +227,11 @@ def _style(cfg, split, restore_step, max_batches):
     # submodule directly on its params subtree (same construction as
     # models/fastspeech2.py), jitted, instead of the whole acoustic model
     import jax
-    import jax.numpy as jnp
 
-    from speakingstyle_tpu.models.reference_encoder import ReferenceEncoder
+    from speakingstyle_tpu.models.factory import reference_encoder_from_config
     from speakingstyle_tpu.ops.masking import length_to_mask
 
-    ref = cfg.model.reference_encoder
-    enc = ReferenceEncoder(
-        n_conv_layers=ref.conv_layer,
-        conv_filter_size=ref.conv_filter_size,
-        conv_kernel_size=ref.conv_kernel_size,
-        n_layers=ref.encoder_layer,
-        n_head=ref.encoder_head,
-        d_model=ref.encoder_hidden,
-        dropout=ref.dropout,
-        n_position=cfg.model.max_seq_len + 1,
-        conv_impl=cfg.model.conv_impl,
-        dtype=jnp.dtype(cfg.model.compute_dtype),
-        softmax_dtype=jnp.dtype(cfg.model.attention_softmax_dtype),
-    )
+    enc = reference_encoder_from_config(cfg)
 
     @jax.jit
     def style_fwd(ref_params, mels, mel_lens):

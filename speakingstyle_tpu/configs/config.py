@@ -179,8 +179,11 @@ class ModelConfig:
     # "xla" = lax.conv emitter, "unfold" = im2col GEMM (one large MXU
     # matmul per conv), "pallas" = fused conv+bias+ReLU(+LN) kernel
     # (ops/pallas_conv.py). Param trees are identical — switchable on a
-    # restored checkpoint.
-    conv_impl: str = "unfold"
+    # restored checkpoint. Default set by the r4 on-chip A/B (PERF.md):
+    # the XLA conv emitter measured fastest end-to-end on v5e (325k
+    # frames/s vs unfold's 265k — the im2col operand's extra HBM traffic
+    # costs more than the cleaner GEMM tiling saves on these shapes).
+    conv_impl: str = "xla"
     # softmax accumulation dtype in attention: "float32" (reference-parity
     # default) or "bfloat16" (A/B candidate; attention is <1% of step
     # FLOPs so this mostly saves VPU/memory traffic)

@@ -34,6 +34,64 @@ def load_dataset_stats(cfg: Config) -> Tuple[tuple, tuple, int]:
     return pitch_stats, energy_stats, n_speakers
 
 
+def reference_encoder_from_config(
+    cfg: Config, n_position: Optional[int] = None, name: Optional[str] = None
+):
+    """The one place ReferenceEncoder kwargs are derived from config —
+    shared by the model (fastspeech2.py), the analyze CLI, and the bench
+    breakdown, so a constructor change can't silently diverge between
+    them."""
+    from speakingstyle_tpu.models.reference_encoder import ReferenceEncoder
+
+    m = cfg.model
+    ref = m.reference_encoder
+    return ReferenceEncoder(
+        n_conv_layers=ref.conv_layer,
+        conv_filter_size=ref.conv_filter_size,
+        conv_kernel_size=ref.conv_kernel_size,
+        n_layers=ref.encoder_layer,
+        n_head=ref.encoder_head,
+        d_model=ref.encoder_hidden,
+        dropout=ref.dropout,
+        n_position=n_position or (m.max_seq_len + 1),
+        conv_impl=m.conv_impl,
+        dtype=jnp.dtype(m.compute_dtype),
+        softmax_dtype=jnp.dtype(m.attention_softmax_dtype),
+        **({"name": name} if name is not None else {}),
+    )
+
+
+def fft_stack_from_config(
+    cfg: Config,
+    which: str,  # "encoder" | "decoder"
+    n_position: Optional[int] = None,
+    seq_mesh=None,
+    name: Optional[str] = None,
+):
+    """Encoder/Decoder construction from config (see
+    reference_encoder_from_config for why this lives here)."""
+    from speakingstyle_tpu.models.transformer import Decoder, Encoder
+
+    m = cfg.model
+    tf = m.transformer
+    cls = {"encoder": Encoder, "decoder": Decoder}[which]
+    return cls(
+        n_layers=getattr(tf, f"{which}_layer"),
+        d_model=getattr(tf, f"{which}_hidden"),
+        n_head=getattr(tf, f"{which}_head"),
+        d_inner=tf.conv_filter_size,
+        kernel_sizes=tuple(tf.conv_kernel_size),
+        dropout=getattr(tf, f"{which}_dropout"),
+        n_position=n_position or (m.max_seq_len + 1),
+        remat=cfg.train.sharding.remat,
+        conv_impl=m.conv_impl,
+        dtype=jnp.dtype(m.compute_dtype),
+        softmax_dtype=jnp.dtype(m.attention_softmax_dtype),
+        seq_mesh=seq_mesh,
+        **({"name": name} if name is not None else {}),
+    )
+
+
 def build_model(
     cfg: Config, n_position: Optional[int] = None, seq_mesh=None
 ) -> FastSpeech2:

@@ -18,8 +18,6 @@ import jax.numpy as jnp
 
 from speakingstyle_tpu.configs.config import Config
 from speakingstyle_tpu.models.postnet import PostNet
-from speakingstyle_tpu.models.reference_encoder import ReferenceEncoder
-from speakingstyle_tpu.models.transformer import Decoder, Encoder
 from speakingstyle_tpu.models.variance_adaptor import VarianceAdaptor
 from speakingstyle_tpu.ops.masking import length_to_mask
 
@@ -54,7 +52,6 @@ class FastSpeech2(nn.Module):
         cfg = self.config.model
         tf = cfg.transformer
         dtype = jnp.dtype(cfg.compute_dtype)
-        sm_dtype = jnp.dtype(cfg.attention_softmax_dtype)
         conv_impl = cfg.conv_impl
         n_position = self.n_position or (cfg.max_seq_len + 1)
 
@@ -64,36 +61,21 @@ class FastSpeech2(nn.Module):
             length_to_mask(mel_lens, mels.shape[1]) if mel_lens is not None else None
         )
 
+        from speakingstyle_tpu.models.factory import (
+            fft_stack_from_config,
+            reference_encoder_from_config,
+        )
+
         gammas = betas = None
         if cfg.use_reference_encoder:
-            ref = cfg.reference_encoder
-            gammas, betas = ReferenceEncoder(
-                n_conv_layers=ref.conv_layer,
-                conv_filter_size=ref.conv_filter_size,
-                conv_kernel_size=ref.conv_kernel_size,
-                n_layers=ref.encoder_layer,
-                n_head=ref.encoder_head,
-                d_model=ref.encoder_hidden,
-                dropout=ref.dropout,
-                n_position=n_position,
-                conv_impl=conv_impl,
-                dtype=dtype,
-                softmax_dtype=sm_dtype,
-                name="reference_encoder",
+            gammas, betas = reference_encoder_from_config(
+                self.config, n_position=n_position, name="reference_encoder"
             )(mels, mel_pad_mask, deterministic=deterministic)
 
-        x = Encoder(
-            n_layers=tf.encoder_layer,
-            d_model=tf.encoder_hidden,
-            n_head=tf.encoder_head,
-            d_inner=tf.conv_filter_size,
-            kernel_sizes=tuple(tf.conv_kernel_size),
-            dropout=tf.encoder_dropout,
+        x = fft_stack_from_config(
+            self.config,
+            "encoder",
             n_position=n_position,
-            remat=self.config.train.sharding.remat,
-            conv_impl=conv_impl,
-            dtype=dtype,
-            softmax_dtype=sm_dtype,
             seq_mesh=self.seq_mesh,
             name="encoder",
         )(texts, src_pad_mask, gammas, betas, deterministic=deterministic)
@@ -135,18 +117,10 @@ class FastSpeech2(nn.Module):
             deterministic=deterministic,
         )
 
-        dec = Decoder(
-            n_layers=tf.decoder_layer,
-            d_model=tf.decoder_hidden,
-            n_head=tf.decoder_head,
-            d_inner=tf.conv_filter_size,
-            kernel_sizes=tuple(tf.conv_kernel_size),
-            dropout=tf.decoder_dropout,
+        dec = fft_stack_from_config(
+            self.config,
+            "decoder",
             n_position=n_position,
-            remat=self.config.train.sharding.remat,
-            conv_impl=conv_impl,
-            dtype=dtype,
-            softmax_dtype=sm_dtype,
             seq_mesh=self.seq_mesh,
             name="decoder",
         )(va["features"], va["mel_pad_mask"], gammas, betas, deterministic=deterministic)

@@ -13,7 +13,7 @@ weight-norm fold with bit-level parity, testable against torch on CPU.
 Channels-last layout throughout so XLA maps the convs onto the MXU.
 """
 
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax
@@ -51,19 +51,25 @@ class TorchConv1d(nn.Module):
 
 
 class TorchConvTranspose1d(nn.Module):
-    """ConvTranspose1d(stride=u, padding=(k-u)//2) with exact torch output
-    length L*u: an lhs-dilated conv with the kernel flipped in time and
-    in/out transposed — the standard transpose-conv equivalence."""
+    """ConvTranspose1d(stride=u, padding=p, output_padding=op) with exact
+    torch output length (L-1)*u - 2p + k + op: an lhs-dilated conv with the
+    kernel flipped in time and in/out transposed — the standard
+    transpose-conv equivalence. ``padding=None`` means torch's
+    HiFi-GAN-style (k-u)//2 (output length exactly L*u for even u);
+    MelGAN's descript layout passes u//2 + u%2 with output_padding u%2,
+    which also lands at L*u for odd upsample ratios."""
 
     features: int
     kernel_size: int
     stride: int
+    padding: Optional[int] = None
+    output_padding: int = 0
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
         k, u = self.kernel_size, self.stride
-        p = (k - u) // 2
+        p = (k - u) // 2 if self.padding is None else self.padding
         in_ch = x.shape[-1]
         # torch ConvTranspose1d weight layout: [in, out, k]
         kernel = self.param(
@@ -79,7 +85,8 @@ class TorchConvTranspose1d(nn.Module):
             x.astype(self.dtype),
             w,
             window_strides=(1,),
-            padding=[(k - 1 - p, k - 1 - p)],
+            # output_padding extends the high side only (torch semantics)
+            padding=[(k - 1 - p, k - 1 - p + self.output_padding)],
             lhs_dilation=(u,),
             dimension_numbers=("NLC", "LIO", "NLC"),
         )

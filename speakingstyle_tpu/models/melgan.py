@@ -95,7 +95,12 @@ class MelGANGenerator(nn.Module):
             ch = mult * self.ngf // 2
             x = nn.leaky_relu(x, MELGAN_LRELU_SLOPE)
             x = TorchConvTranspose1d(
-                ch, 2 * r, r, dtype=self.dtype, name=f"ups_{i}"
+                ch, 2 * r, r,
+                # descript layout: supports odd upsample ratios too
+                padding=r // 2 + r % 2,
+                output_padding=r % 2,
+                dtype=self.dtype,
+                name=f"ups_{i}",
             )(x)
             for j in range(self.n_residual_layers):
                 x = MelGANResBlock(

@@ -65,11 +65,14 @@ def _reference_fused(x, kernel, bias, ln_scale, ln_bias, dilation, relu):
 
 
 def _kernel(x_hbm, w_ref, b_ref, s_ref, sb_ref, out_ref, x_vmem, sem, *,
-            tile, span, taps, dilation, relu, ln):
+            tile, copy_len, taps, dilation, relu, ln):
     b = pl.program_id(0)
     t = pl.program_id(1)
+    # copy_len is (tile + span - 1) rounded up to the sublane tiling (8):
+    # Mosaic requires DMA slice shapes aligned to the memref tiling. The
+    # rows past tile+span-1 are junk halo and never read by the taps.
     copy = pltpu.make_async_copy(
-        x_hbm.at[b, pl.ds(t * tile, tile + span - 1), :], x_vmem, sem
+        x_hbm.at[b, pl.ds(t * tile, copy_len), :], x_vmem, sem
     )
     copy.start()
     copy.wait()
@@ -91,6 +94,9 @@ def _kernel(x_hbm, w_ref, b_ref, s_ref, sb_ref, out_ref, x_vmem, sem, *,
     out_ref[0] = acc.astype(out_ref.dtype)
 
 
+LANE = 128  # Mosaic lane tiling: channel dims in DMA slices must align
+
+
 def _fused_fwd_pallas(x, kernel, bias, ln_scale, ln_bias, dilation, relu,
                       tile, interpret):
     B, T, cin = x.shape
@@ -99,9 +105,30 @@ def _fused_fwd_pallas(x, kernel, bias, ln_scale, ln_bias, dilation, relu,
     pad_lo = (span - 1) // 2
     n_t = pl.cdiv(T, tile)
     t_pad = n_t * tile
-    # SAME padding plus right-fill up to the tile grid; extra rows are junk
-    # and sliced off after the call
-    xp = jnp.pad(x, ((0, 0), (pad_lo, span - 1 - pad_lo + t_pad - T), (0, 0)))
+    # DMA slices must be sublane(8)-aligned in length; round the halo copy up
+    copy_len = -(-(tile + span - 1) // 8) * 8
+    # SAME padding plus right-fill so the last tile's copy_len DMA is in range
+    right = (t_pad - tile + copy_len) - T - pad_lo
+    xp = jnp.pad(x, ((0, 0), (pad_lo, right), (0, 0)))
+    # Channel dims must be lane(128)-aligned for the manual HBM slice (cin)
+    # and the output block (cout): zero-pad both — zeros contribute nothing
+    # to the taps' dot products, and padded output columns are sliced off.
+    # (The ln=True call sites are the 1024-channel ref-encoder stack, always
+    # aligned; _fused falls back to the reference impl for unaligned-ln.)
+    cin_p = -(-cin // LANE) * LANE
+    cout_p = -(-cout // LANE) * LANE
+    if cin_p != cin:
+        xp = jnp.pad(xp, ((0, 0), (0, 0), (0, cin_p - cin)))
+        kernel = jnp.pad(kernel, ((0, 0), (0, cin_p - cin), (0, 0)))
+    if cout_p != cout:
+        kernel = jnp.pad(kernel, ((0, 0), (0, 0), (0, cout_p - cout)))
+        if bias is not None:
+            bias = jnp.pad(bias, (0, cout_p - cout))
+        if ln_scale is not None:
+            ln_scale = jnp.pad(ln_scale, (0, cout_p - cout))
+            ln_bias = jnp.pad(ln_bias, (0, cout_p - cout))
+    cout_orig = cout
+    cin, cout = cin_p, cout_p
 
     if bias is None:
         bias = jnp.zeros((cout,), x.dtype)
@@ -111,7 +138,7 @@ def _fused_fwd_pallas(x, kernel, bias, ln_scale, ln_bias, dilation, relu,
         ln_bias = jnp.zeros((cout,), x.dtype)
 
     kern = functools.partial(
-        _kernel, tile=tile, span=span, taps=K, dilation=dilation,
+        _kernel, tile=tile, copy_len=copy_len, taps=K, dilation=dilation,
         relu=relu, ln=ln,
     )
     vec = lambda v: v.reshape(1, cout)
@@ -128,12 +155,19 @@ def _fused_fwd_pallas(x, kernel, bias, ln_scale, ln_bias, dilation, relu,
         out_specs=pl.BlockSpec((1, tile, cout), lambda b, t: (b, t, 0)),
         out_shape=jax.ShapeDtypeStruct((B, t_pad, cout), x.dtype),
         scratch_shapes=[
-            pltpu.VMEM((tile + span - 1, cin), x.dtype),
+            pltpu.VMEM((copy_len, cin), x.dtype),
             pltpu.SemaphoreType.DMA,
         ],
         interpret=interpret,
     )(xp, kernel, vec(bias), vec(ln_scale), vec(ln_bias))
-    return out[:, :T, :]
+    return out[:, :T, :cout_orig]
+
+
+def _pick_tile(tile: int, T: int) -> int:
+    """Clamp the time tile to the sequence and round up to the sublane
+    tiling (8): Mosaic requires both block shapes and tile offsets
+    (t * tile) to be 8-divisible on the second-minor dimension."""
+    return min(-(-tile // 8) * 8, max(8, -(-T // 8) * 8))
 
 
 def _use_interpret() -> bool:
@@ -157,10 +191,14 @@ def _use_interpret() -> bool:
 )
 def _fused(x, kernel, bias, ln_scale, ln_bias, dilation, relu, tile,
            interpret):
-    if not _HAVE_PLTPU:
-        # no pallas-TPU module at all (even the interpreter path uses its
-        # DMA/scratch primitives) — run the mathematically identical
-        # reference implementation instead of failing later
+    if not _HAVE_PLTPU or (
+        ln_scale is not None and kernel.shape[-1] % LANE != 0
+    ):
+        # No pallas-TPU module at all (even the interpreter path uses its
+        # DMA/scratch primitives), or an in-kernel LayerNorm over a
+        # non-lane-aligned channel count (the kernel's mean/var would
+        # average the alignment padding) — run the mathematically
+        # identical reference implementation instead of failing later.
         return _reference_fused(
             x, kernel, bias, ln_scale, ln_bias, dilation, relu
         )
@@ -208,7 +246,7 @@ def fused_conv1d(
     x [B,T,Cin], kernel [K,Cin,Cout], bias [Cout]. Differentiable.
     """
     interpret = _use_interpret() if interpret is None else interpret
-    tile = min(tile, max(8, x.shape[1]))
+    tile = _pick_tile(tile, x.shape[1])
     return _fused(x, kernel, bias, None, None, dilation, relu, tile,
                   interpret)
 
@@ -227,6 +265,6 @@ def fused_conv_relu_ln(
     """conv1d -> ReLU -> LayerNorm in one pass (the reference-encoder conv
     stack pattern, reference: model/modules.py:361-379). Differentiable."""
     interpret = _use_interpret() if interpret is None else interpret
-    tile = min(tile, max(8, x.shape[1]))
+    tile = _pick_tile(tile, x.shape[1])
     return _fused(x, kernel, bias, ln_scale, ln_bias, dilation, True, tile,
                   interpret)

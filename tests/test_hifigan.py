@@ -10,6 +10,7 @@ the conv semantics (padding, transposed-conv equivalence) and the converter
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 import torch
 import torch.nn as tnn
 from torch.nn.utils import weight_norm
@@ -213,21 +214,26 @@ def _torch_melgan(n_mels=80, ngf=8, n_residual_layers=2, ratios=(4, 2)):
     return TorchMelGAN()
 
 
-def test_melgan_torch_parity():
+@pytest.mark.parametrize("ratios", [(4, 2), (4, 3)])
+def test_melgan_torch_parity(ratios):
+    """(4, 3) covers odd upsample ratios, where descript's transposed conv
+    uses padding=r//2 + r%2 with output_padding=r%2 — several public MelGAN
+    variants ship odd ratios, and the even-ratio formula silently
+    mis-shifts them."""
     from speakingstyle_tpu.compat.torch_convert import convert_melgan
     from speakingstyle_tpu.models.melgan import MelGANGenerator
 
     torch.manual_seed(0)
-    tgen = _torch_melgan().eval()
+    tgen = _torch_melgan(ratios=ratios).eval()
     sd = {k: v.detach().numpy() for k, v in tgen.state_dict().items()}
     params = convert_melgan(sd)
 
-    gen = MelGANGenerator(n_mels=80, ngf=8, n_residual_layers=2, ratios=(4, 2))
+    gen = MelGANGenerator(n_mels=80, ngf=8, n_residual_layers=2, ratios=ratios)
     mel = np.random.default_rng(0).standard_normal((2, 13, 80)).astype(np.float32)
     wav_jax = np.asarray(gen.apply({"params": params}, jnp.asarray(mel)))
     with torch.no_grad():
         wav_torch = tgen(torch.from_numpy(mel).transpose(1, 2)).numpy()[:, 0]
-    assert wav_jax.shape == wav_torch.shape  # 8x upsampling here
+    assert wav_jax.shape == wav_torch.shape
     np.testing.assert_allclose(wav_jax, wav_torch, atol=1e-5)
 
 

@@ -213,7 +213,7 @@ def test_conv_impls_identical_tree_and_outputs(model_and_vars):
     )
     speakers = jnp.zeros((2,), jnp.int32)
 
-    base_cfg = tiny_config()  # conv_impl="unfold" (ModelConfig default)
+    base_cfg = tiny_config()  # conv_impl="xla" (ModelConfig default)
     outs = {}
     trees = {}
     for impl in ("xla", "unfold", "pallas"):
