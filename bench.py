@@ -24,12 +24,13 @@ Measured perf notes (v5e single chip, 2026-07 round 1):
     fix): compute-bound, not dispatch- or batch-bound.
   * threefry dropout-mask generation cost ~15% of the step; the RBG
     default (TrainConfig.fast_prng) recovers it -> ~320k frames/s.
-  * round 4 FLOP-level work (the 1.28x -> 3x plan): ~90% of step FLOPs
-    are conv1d; ``model.conv_impl`` now selects the lowering — "unfold"
-    (default) turns every conv into one im2col GEMM the MXU tiles at
-    near-peak, "pallas" is the fused conv+bias+ReLU+LN kernel
-    (ops/pallas_conv.py), "xla" the old spatial-conv emitter. Plus a
-    bf16-softmax knob. ``python bench.py --ab`` measures all variants;
+  * round 4 FLOP-level work (the 1.28x -> 3x plan): ``model.conv_impl``
+    selects the conv lowering — the on-chip A/B crowned "xla" (the
+    spatial-conv emitter, now the default; the im2col "unfold" GEMM
+    projection lost by 19%), and ``model.attention_kernel="fused"``
+    engages the fused-MHA pallas kernel (ops/pallas_attention.py) that
+    took the step from 1.50x to 1.77x. See PERF.md for the full measured
+    story. ``python bench.py --ab`` measures all variants;
     ``--inner --profile`` writes a jax.profiler trace to ./profile_trace.
 """
 
@@ -55,12 +56,14 @@ WARMUP_STEPS, BENCH_STEPS = 3, 50
 
 # The headline measures the TPU-tuned training config (README "Performance
 # knobs"): the r4 on-chip A/B measured conv_impl=xla fastest end-to-end
-# (325k vs unfold's 265k frames/s) and bf16 softmax worth +13% (325k ->
-# 369k). ModelConfig's own default keeps the reference-parity f32 softmax;
-# the bf16 knob's output delta is bounded by
-# tests/test_models.py::test_attention_softmax_dtype_bf16_close. The knobs
-# used are echoed in the JSON line as "config".
-TUNED_OVERRIDES = {"conv_impl": "xla", "attention_softmax_dtype": "bfloat16"}
+# (325k vs unfold's 265k frames/s), bf16 softmax worth +13% on the einsum
+# path (325k -> 369k), and the fused-MHA pallas kernel
+# (ops/pallas_attention.py) worth another large step on top — its VMEM
+# softmax is f32, so it is MORE accurate than the bf16-softmax einsum
+# variant while being faster. ModelConfig's own defaults keep the
+# reference-parity einsum/f32 path; the knobs used are echoed in the JSON
+# line as "overrides".
+TUNED_OVERRIDES = {"conv_impl": "xla", "attention_kernel": "fused"}
 
 
 def make_batch(n_mels: int, rng):
@@ -238,8 +241,9 @@ def run_breakdown():
         rng.standard_normal((B, T_MEL, m.transformer.decoder_hidden)), dtype
     )
     texts = jnp.asarray(rng.integers(1, 360, (B, L_SRC)), jnp.int32)
-    src_mask = jnp.ones((B, L_SRC), bool)
-    mel_mask = jnp.ones((B, T_MEL), bool)
+    # mask convention: True = padded (ops/masking.py) — all-False = all real
+    src_mask = jnp.zeros((B, L_SRC), bool)
+    mel_mask = jnp.zeros((B, T_MEL), bool)
 
     cases = [
         ("reference_encoder", reference_encoder_from_config(cfg), (mels, mel_mask)),
@@ -283,7 +287,8 @@ def run_ab():
         {"conv_impl": "unfold"},
         {"conv_impl": "pallas"},
         {"conv_impl": "xla", "attention_softmax_dtype": "bfloat16"},
-        {"conv_impl": "pallas", "attention_softmax_dtype": "bfloat16"},
+        {"conv_impl": "xla", "attention_kernel": "fused"},
+        {"conv_impl": "pallas", "attention_kernel": "fused"},
     ]
     for ov in variants:
         try:

@@ -57,6 +57,7 @@ def reference_encoder_from_config(
         conv_impl=m.conv_impl,
         dtype=jnp.dtype(m.compute_dtype),
         softmax_dtype=jnp.dtype(m.attention_softmax_dtype),
+        attention_kernel=m.attention_kernel,
         **({"name": name} if name is not None else {}),
     )
 
@@ -87,6 +88,7 @@ def fft_stack_from_config(
         conv_impl=m.conv_impl,
         dtype=jnp.dtype(m.compute_dtype),
         softmax_dtype=jnp.dtype(m.attention_softmax_dtype),
+        attention_kernel=m.attention_kernel,
         seq_mesh=seq_mesh,
         **({"name": name} if name is not None else {}),
     )

@@ -53,6 +53,7 @@ class MultiHeadSelfAttention(nn.Module):
     dropout: float
     dtype: jnp.dtype = jnp.float32
     softmax_dtype: jnp.dtype = jnp.float32
+    attention_kernel: str = "einsum"  # "einsum" | "fused" (pallas)
     seq_mesh: Optional[object] = None  # jax.sharding.Mesh with a "seq" axis
 
     @nn.compact
@@ -84,6 +85,12 @@ class MultiHeadSelfAttention(nn.Module):
                 .reshape(B, L, self.d_model)
                 .astype(self.dtype)
             )
+        elif self.attention_kernel == "fused":
+            from speakingstyle_tpu.ops.pallas_attention import fused_mha
+
+            # f32 softmax always (it lives in VMEM — free); falls back to
+            # the einsum path off-TPU or for unsupported shapes
+            out = fused_mha(q, k, v, pad_mask).reshape(B, L, self.d_model)
         else:
             sm_dtype = jnp.dtype(self.softmax_dtype)
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
@@ -157,6 +164,7 @@ class FFTBlock(nn.Module):
     conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
     softmax_dtype: jnp.dtype = jnp.float32
+    attention_kernel: str = "einsum"
     seq_mesh: Optional[object] = None
 
     @nn.compact
@@ -164,6 +172,7 @@ class FFTBlock(nn.Module):
         x = MultiHeadSelfAttention(
             self.n_head, self.d_model, self.dropout, dtype=self.dtype,
             softmax_dtype=self.softmax_dtype,
+            attention_kernel=self.attention_kernel,
             seq_mesh=self.seq_mesh, name="slf_attn"
         )(x, pad_mask, deterministic)
         x = mask_fill(x, pad_mask)

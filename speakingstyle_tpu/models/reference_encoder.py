@@ -34,6 +34,7 @@ class ReferenceEncoder(nn.Module):
     conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
     softmax_dtype: jnp.dtype = jnp.float32
+    attention_kernel: str = "einsum"
 
     @nn.compact
     def __call__(self, mel, pad_mask, deterministic=True):
@@ -104,6 +105,7 @@ class ReferenceEncoder(nn.Module):
                 conv_impl=self.conv_impl,
                 dtype=self.dtype,
                 softmax_dtype=self.softmax_dtype,
+                attention_kernel=self.attention_kernel,
                 name=f"fftb_{i}",
             )(x, pad_mask, deterministic=deterministic)
 

@@ -36,6 +36,7 @@ class FFTStack(nn.Module):
     conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
     softmax_dtype: jnp.dtype = jnp.float32
+    attention_kernel: str = "einsum"
     seq_mesh: Optional[object] = None  # engages ring attention when set
 
     @nn.compact
@@ -57,6 +58,7 @@ class FFTStack(nn.Module):
                 conv_impl=self.conv_impl,
                 dtype=self.dtype,
                 softmax_dtype=self.softmax_dtype,
+                attention_kernel=self.attention_kernel,
                 seq_mesh=self.seq_mesh,
                 name=f"layer_{i}",
             )(x, pad_mask, gammas, betas, deterministic)
@@ -78,6 +80,7 @@ class Encoder(nn.Module):
     conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
     softmax_dtype: jnp.dtype = jnp.float32
+    attention_kernel: str = "einsum"
     seq_mesh: Optional[object] = None
 
     @nn.compact
@@ -101,6 +104,7 @@ class Encoder(nn.Module):
             conv_impl=self.conv_impl,
             dtype=self.dtype,
             softmax_dtype=self.softmax_dtype,
+            attention_kernel=self.attention_kernel,
             seq_mesh=self.seq_mesh,
             name="layer_stack",
         )(x, pad_mask, gammas, betas, deterministic)
@@ -120,6 +124,7 @@ class Decoder(nn.Module):
     conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
     softmax_dtype: jnp.dtype = jnp.float32
+    attention_kernel: str = "einsum"
     seq_mesh: Optional[object] = None
 
     @nn.compact
@@ -137,6 +142,7 @@ class Decoder(nn.Module):
             conv_impl=self.conv_impl,
             dtype=self.dtype,
             softmax_dtype=self.softmax_dtype,
+            attention_kernel=self.attention_kernel,
             seq_mesh=self.seq_mesh,
             name="layer_stack",
         )(x, pad_mask, gammas, betas, deterministic)
