@@ -295,6 +295,16 @@ class TrainConfig:
     # reference counterpart (torch RNG is cuRAND); disable for bit-stable
     # dropout streams across hardware.
     fast_prng: bool = True
+    # Run clip+Adam+LR as one fused pass over a single raveled parameter
+    # vector (training/optim.py make_fused_optimizer) instead of the
+    # per-leaf optax chain: mathematically identical update (parity test
+    # in tests/test_training.py), different opt_state layout (flat mu/nu),
+    # so checkpoints are not interchangeable with the unfused optimizer.
+    # A recorded NEGATIVE result on v5e at 35M params: the ravel/unravel
+    # copies cost more than the chain overhead they remove (422.6k vs
+    # 442.8k frames/s — see PERF.md), so this stays off by default and is
+    # kept as an honest A/B knob.
+    fused_optimizer: bool = False
 
 
 @dataclass(frozen=True)

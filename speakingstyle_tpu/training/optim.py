@@ -8,8 +8,23 @@ milestone passed. The lr for step s uses ``current_step = s + 1``
 
 Built as an optax chain: clip_by_global_norm(1.0) -> adam(b1=0.9, b2=0.98,
 eps=1e-9) -> schedule; grad accumulation via optax.MultiSteps.
+
+``train.fused_optimizer`` swaps in ``make_fused_optimizer``: the same math
+as one fused pass over a single raveled gradient vector. The hypothesis
+was that the optax chain's ~200 leaves x 4 stages of per-leaf fusions
+(5.4 ms/step at 35M params on v5e, ~1.5 ms of it intrinsic HBM traffic)
+could be collapsed — but the measured end-to-end result is NEGATIVE: the
+ravel/unravel copies cost more than the chain overhead they remove
+(422.6k vs 442.8k frames/s, PERF.md). Kept as an honest A/B knob, off by
+default. Update parity with the chain is pinned by
+tests/test_training.py::test_fused_optimizer_matches_chain.
 """
 
+from typing import NamedTuple
+
+import chex
+import jax
+import jax.flatten_util  # registers jax.flatten_util.ravel_pytree
 import jax.numpy as jnp
 import optax
 
@@ -34,7 +49,63 @@ def make_lr_schedule(train_cfg: TrainConfig):
     return schedule
 
 
+class FlatAdamState(NamedTuple):
+    """Adam moments stored as single flat vectors (not per-leaf trees)."""
+
+    count: chex.Array  # int32 scalar
+    mu: chex.Array     # [n_params] f32
+    nu: chex.Array     # [n_params] f32
+
+
+def make_fused_optimizer(train_cfg: TrainConfig) -> optax.GradientTransformation:
+    """clip_by_global_norm -> (L2 weight decay) -> Adam -> -lr, computed in
+    one fused pass over the raveled gradient vector. Identical update math
+    to the optax chain in make_optimizer (same stage order and the same
+    step-count semantics: bias correction uses count+1, the schedule is
+    evaluated at count)."""
+    opt = train_cfg.optimizer
+    schedule = make_lr_schedule(train_cfg)
+    b1, b2 = opt.betas
+    eps, clip, wd = opt.eps, opt.grad_clip_thresh, opt.weight_decay
+
+    def init(params):
+        flat, _ = jax.flatten_util.ravel_pytree(params)
+        flat = flat.astype(jnp.float32)
+        return FlatAdamState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jnp.zeros_like(flat),
+            nu=jnp.zeros_like(flat),
+        )
+
+    def update(grads, state, params=None):
+        g, unravel = jax.flatten_util.ravel_pytree(grads)
+        g = g.astype(jnp.float32)
+        # optax.clip_by_global_norm: scale only when the norm exceeds clip
+        gnorm = jnp.linalg.norm(g)
+        g = g * jnp.where(gnorm < clip, 1.0, clip / gnorm)
+        if wd:
+            if params is None:
+                raise ValueError("weight_decay needs params")
+            p, _ = jax.flatten_util.ravel_pytree(params)
+            g = g + wd * p.astype(jnp.float32)
+        count_inc = state.count + 1
+        mu = b1 * state.mu + (1.0 - b1) * g
+        nu = b2 * state.nu + (1.0 - b2) * jnp.square(g)
+        mu_hat = mu / (1.0 - b1 ** count_inc.astype(jnp.float32))
+        nu_hat = nu / (1.0 - b2 ** count_inc.astype(jnp.float32))
+        lr = schedule(state.count)
+        upd = -lr * mu_hat / (jnp.sqrt(nu_hat) + eps)
+        return unravel(upd), FlatAdamState(count=count_inc, mu=mu, nu=nu)
+
+    tx = optax.GradientTransformation(init, update)
+    if opt.grad_acc_step > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=opt.grad_acc_step)
+    return tx
+
+
 def make_optimizer(train_cfg: TrainConfig) -> optax.GradientTransformation:
+    if train_cfg.fused_optimizer:
+        return make_fused_optimizer(train_cfg)
     opt = train_cfg.optimizer
     tx = optax.chain(
         optax.clip_by_global_norm(opt.grad_clip_thresh),
