@@ -252,11 +252,13 @@ def test_training_descends_on_learnable_synthetic_corpus(tmp_path):
     assert late < 0.7 * early, (early, late, losses)
 
 
-def test_fused_optimizer_matches_chain():
+@pytest.mark.parametrize("weight_decay,grad_acc", [(0.0, 1), (0.01, 1), (0.0, 2)])
+def test_fused_optimizer_matches_chain(weight_decay, grad_acc):
     """make_fused_optimizer (one pass over the raveled vector) produces the
     same parameter trajectory as the optax chain — including the global-norm
-    clip engaging (step with large grads), bias correction, and the LR
-    schedule's step indexing."""
+    clip engaging (step with large grads), bias correction, the LR
+    schedule's step indexing, the L2-before-moments weight decay, and the
+    MultiSteps grad-accumulation wrapper."""
     import optax
 
     from speakingstyle_tpu.configs.config import TrainConfig
@@ -266,6 +268,12 @@ def test_fused_optimizer_matches_chain():
     )
 
     cfg = TrainConfig()
+    cfg = dataclasses.replace(
+        cfg,
+        optimizer=dataclasses.replace(
+            cfg.optimizer, weight_decay=weight_decay, grad_acc_step=grad_acc
+        ),
+    )
     rng = np.random.default_rng(0)
     params = {
         "a": {"w": jnp.asarray(rng.standard_normal((7, 5)), jnp.float32)},
