@@ -292,6 +292,80 @@ def run_breakdown():
                                                "T_mel": T_MEL}}))
 
 
+def run_infer():
+    """Inference-side benchmark: free-running acoustic synthesis and
+    HiFi-GAN vocoding on the chip, reported as realtime factors (seconds
+    of 22050 Hz audio generated per wall second). Complements the training
+    headline; the reference has no counterpart numbers (SURVEY.md §6), so
+    these lines are recorded for BASELINE_NOTES-style tracking."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from speakingstyle_tpu.configs.config import Config
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.models.hifigan import Generator
+
+    jax.config.update("jax_default_prng_impl", "rbg")
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    cfg = _apply_overrides(Config(), TUNED_OVERRIDES)
+    rng = np.random.default_rng(0)
+    hop, sr = 256, 22050
+    n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+
+    def time_realtime(fn, *args, n_frames):
+        """Compile+warm fn(*args), time it, return (dt_s, realtime_x)."""
+        out = fn(*args)
+        float(out.ravel()[0])  # D2H sync
+        _mark("compile+warmup done")
+        t0 = time.perf_counter()
+        for _ in range(BENCH_STEPS):
+            out = fn(*args)
+        float(out.ravel()[0])
+        dt = (time.perf_counter() - t0) / BENCH_STEPS
+        return dt, n_frames * hop / sr / dt
+
+    # --- free-running acoustic model (teacher targets absent) ---
+    model = build_model(cfg)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    _mark("acoustic init done")
+    batch = {
+        k: v for k, v in make_batch(n_mels, rng).items()
+        if k not in ("pitches", "energies", "durations")
+    }
+    fwd = jax.jit(
+        # max_mel_len is a static shape argument (the free-running mel
+        # buffer length), so it is closed over rather than traced
+        lambda v, b: model.apply(v, deterministic=True, **b,
+                                 max_mel_len=T_MEL,
+                                 mutable=["batch_stats"])[0]["mel_postnet"]
+    )
+    dt, rt = time_realtime(fwd, variables, batch, n_frames=B * T_MEL)
+    print(json.dumps({
+        "metric": "synthesis_realtime_factor",
+        "value": round(rt, 1),
+        "unit": f"x realtime (acoustic mel generation, batch {B})",
+        "mel_frames_per_sec": round(B * T_MEL / dt, 1),
+    }))
+
+    # --- HiFi-GAN vocoder (random weights; compute identical to trained) ---
+    gen = Generator(dtype=jnp.bfloat16)
+    Bv = 8
+    mels = jnp.asarray(rng.standard_normal((Bv, T_MEL, n_mels)), jnp.float32)
+    params = gen.init(jax.random.PRNGKey(0), mels)["params"]
+    voc = jax.jit(lambda p, m: gen.apply({"params": p}, m))
+    dt, rt = time_realtime(voc, params, mels, n_frames=Bv * T_MEL)
+    print(json.dumps({
+        "metric": "hifigan_realtime_factor",
+        "value": round(rt, 1),
+        "unit": f"x realtime (mel->wav, batch {Bv}, bf16)",
+        "samples_per_sec": round(Bv * T_MEL * hop / dt, 1),
+    }))
+
+
 def run_ab():
     """A/B the performance knobs (README "Performance knobs"): one process
     per variant so each gets a clean backend; prints one JSON line each."""
@@ -399,6 +473,8 @@ if __name__ == "__main__":
         main(report_flops=True)
     elif "--breakdown" in sys.argv:
         run_breakdown()
+    elif "--infer" in sys.argv:
+        run_infer()
     elif "--ab" in sys.argv:
         run_ab()
     elif "--inner" in sys.argv:

@@ -25,10 +25,12 @@ and the identical ``{"kernel": [K, Cin, Cout], "bias": [Cout]}`` param
 entry, so ``conv_impl`` can change per run — including on a restored
 checkpoint — without any conversion.
 
-Exception to the dispatch: **K=1 convs always lower as an einsum matmul**
-(regardless of ``conv_impl`` — they are not spatial convolutions, and the
-einsum measures ~19% faster than the conv emitter at model shapes). The
-"xla"-vs-"unfold" A/B therefore compares lowerings of the K>1 convs only.
+Exception to the dispatch: **K=1 convs lower as an einsum matmul** for
+the "xla" and "unfold" impls (they are not spatial convolutions, and the
+einsum measures ~19% faster than the conv emitter at model shapes); the
+"pallas" impl keeps its fused kernel so conv+ReLU stays one VMEM pass.
+The "xla"-vs-"unfold" A/B therefore compares lowerings of the K>1 convs
+only.
 """
 
 from typing import Optional
@@ -99,6 +101,17 @@ class Conv1d(nn.Module):
         x, kernel, bias = nn.dtypes.promote_dtype(
             x, kernel, bias, dtype=self.dtype
         )
+        if self.kernel_size == 1 and self.impl != "pallas":
+            # K=1 is mathematically a matmul, lowered as einsum (module
+            # docstring "Exception"): ~19% faster fwd+bwd than the conv
+            # emitter at model shapes ([48,600,1024]->256: 1.05 vs
+            # 1.29 ms), ~14 such convs per step (FFN second halves).
+            # The pallas impl keeps its own path so its fused ReLU
+            # epilogue stays in one kernel.
+            y = conv1d_unfold(x, kernel, bias, dilation=self.dilation)
+            if self.activation == "relu":
+                y = jnp.maximum(y, 0.0)
+            return y
         if self.impl == "pallas":
             from speakingstyle_tpu.ops.pallas_conv import fused_conv1d
 
@@ -107,11 +120,7 @@ class Conv1d(nn.Module):
                 dilation=self.dilation,
                 relu=self.activation == "relu",
             )
-        if self.impl == "unfold" or self.kernel_size == 1:
-            # K=1 is mathematically a matmul, lowered as einsum for EVERY
-            # impl (module docstring "Exception"): ~19% faster fwd+bwd than
-            # the conv emitter at model shapes ([48,600,1024]->256: 1.05 vs
-            # 1.29 ms), ~14 such convs per step (FFN second halves)
+        if self.impl == "unfold":
             y = conv1d_unfold(x, kernel, bias, dilation=self.dilation)
         else:
             y = jax.lax.conv_general_dilated(

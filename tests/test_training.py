@@ -211,6 +211,7 @@ def test_training_descends_on_learnable_synthetic_corpus(tmp_path):
     )
     from speakingstyle_tpu.data.synthetic import generate_corpus
     from speakingstyle_tpu.training.trainer import run_training
+    from tests.test_models import tiny_config
 
     corpus = str(tmp_path / "corpus")
     generate_corpus(corpus, n_utts=40, val_utts=4,
@@ -231,7 +232,11 @@ def test_training_descends_on_learnable_synthetic_corpus(tmp_path):
                 log_path=str(tmp_path / "log"),
                 result_path=str(tmp_path / "res"),
             ),
-            optimizer=OptimizerConfig(batch_size=8),
+            # init_lr=anneal_lr=1e-3: the reference ramp would still be at
+            # lr~1e-4 by step 40, far too cold for a 40-step descent check
+            optimizer=OptimizerConfig(
+                batch_size=8, init_lr=1e-3, anneal_lr=1e-3
+            ),
             step=StepConfig(total_step=40, log_step=5, val_step=1000,
                             save_step=20, synth_step=10**9),
         ),
