@@ -194,7 +194,13 @@ class ModelConfig:
     # VMEM pass per (batch, head), f32 softmax in-register; measured ~1.7x
     # faster fwd+bwd at paper shapes). "fused" needs TPU hardware and
     # L <= 1024 / head_dim <= 128; it falls back to einsum elsewhere.
-    # Parameter-free, so switchable on a restored checkpoint.
+    # Parameter-free, so switchable on a restored checkpoint. Sharding:
+    # the kernel carries a custom_partitioning batch rule — without it
+    # GSPMD ALL-GATHERS the operands of a custom call. Validated: zero
+    # all-gathers + batch-sharded grads in the 8-device-mesh HLO
+    # (tests/test_parallel.py::test_fused_attention_batch_partitioned_*),
+    # loss parity with einsum under the data-sharded train step, and
+    # hardware execution on the 1-chip mesh (PERF.md).
     attention_kernel: str = "einsum"
     # "dense" or "ring": ring engages sequence-parallel exact attention
     # (parallel/ring_attention.py) in the encoder/decoder FFT stacks for

@@ -42,6 +42,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.custom_partitioning import custom_partitioning
+from jax.sharding import NamedSharding, PartitionSpec
 
 try:  # pltpu imports fail on builds without the TPU plugin; fallback then
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
@@ -136,7 +138,7 @@ def _bias_spec(Tp):
     return pl.BlockSpec((1, 1, Tp), lambda b, h: (b, 0, 0))
 
 
-def _call_fwd(qT, kT, vT, bias, sm_scale, sm_dtype, interpret):
+def _pallas_fwd(qT, kT, vT, bias, sm_scale, sm_dtype, interpret):
     B, H, D, Tp = qT.shape
     return pl.pallas_call(
         functools.partial(_fwd_kernel, sm_scale=sm_scale, sm_dtype=sm_dtype),
@@ -148,7 +150,7 @@ def _call_fwd(qT, kT, vT, bias, sm_scale, sm_dtype, interpret):
     )(qT, kT, vT, bias)
 
 
-def _call_bwd(qT, kT, vT, bias, doT, sm_scale, sm_dtype, interpret):
+def _pallas_bwd(qT, kT, vT, bias, doT, sm_scale, sm_dtype, interpret):
     B, H, D, Tp = qT.shape
     shape = jax.ShapeDtypeStruct((B, H, D, Tp), qT.dtype)
     return pl.pallas_call(
@@ -159,6 +161,72 @@ def _call_bwd(qT, kT, vT, bias, doT, sm_scale, sm_dtype, interpret):
         out_shape=(shape, shape, shape),
         interpret=interpret,
     )(qT, kT, vT, bias, doT)
+
+
+def _batch_partitioned(fn, rule: str):
+    """Wrap a per-batch-independent pallas entry in custom_partitioning so
+    GSPMD shards it along the batch dim instead of all-gathering the
+    operands (which it does for unannotated custom calls — verified in
+    HLO). ``rule`` is a Shardy einsum-like sharding rule whose only shared
+    factor is the batch dim ``b``; the partition callback forces every
+    operand/result to batch-only sharding (replicated on H/D/T — the
+    kernel needs whole sequences) and lowers the same pallas call on the
+    shard's batch slice. Falls back to full replication when the batch
+    axis doesn't divide the shard count."""
+
+    cp = custom_partitioning(fn, static_argnums=())
+
+    def _batch_axis(mesh, arg_infos):
+        spec = getattr(arg_infos[0].sharding, "spec", None)
+        b = spec[0] if spec and len(spec) > 0 else None
+        if b is None:
+            return None
+        axes = (b,) if isinstance(b, str) else tuple(b)
+        n = 1
+        for a in axes:
+            n *= mesh.shape[a]
+        return b if arg_infos[0].shape[0] % n == 0 else None
+
+    def _batch_only(mesh, b, infos):
+        return tuple(
+            NamedSharding(mesh, PartitionSpec(b, *(None,) * (len(i.shape) - 1)))
+            for i in infos
+        )
+
+    def partition(mesh, arg_infos, result_infos):
+        b = _batch_axis(mesh, arg_infos)
+        arg_sh = _batch_only(mesh, b, arg_infos)
+        if isinstance(result_infos, (list, tuple)):
+            out_sh = _batch_only(mesh, b, result_infos)
+        else:
+            out_sh = _batch_only(mesh, b, (result_infos,))[0]
+        return mesh, fn, out_sh, arg_sh
+
+    cp.def_partition(partition=partition, sharding_rule=rule)
+    return cp
+
+
+_FWD_RULE = "b h d t, b h d t, b h d t, b i t -> b h d t"
+_BWD_RULE = (
+    "b h d t, b h d t, b h d t, b i t, b h d t "
+    "-> b h d t, b h d t, b h d t"
+)
+
+
+def _call_fwd(qT, kT, vT, bias, sm_scale, sm_dtype, interpret):
+    # custom_partitioning requires a purely positional callee
+    def fn(qT, kT, vT, bias):
+        return _pallas_fwd(qT, kT, vT, bias, sm_scale, sm_dtype, interpret)
+
+    return _batch_partitioned(fn, _FWD_RULE)(qT, kT, vT, bias)
+
+
+def _call_bwd(qT, kT, vT, bias, doT, sm_scale, sm_dtype, interpret):
+    def fn(qT, kT, vT, bias, doT):
+        return _pallas_bwd(qT, kT, vT, bias, doT, sm_scale, sm_dtype,
+                           interpret)
+
+    return _batch_partitioned(fn, _BWD_RULE)(qT, kT, vT, bias, doT)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
@@ -195,6 +263,13 @@ def _reference_mha(q, k, v, pad_mask, sm_scale, softmax_dtype):
     return jnp.einsum("bhqk,bkhd->bqhd", attn, v)
 
 
+# Test hook: when True, the auto path runs the kernel in interpret mode
+# even off-TPU, so sharded-mesh CPU tests can exercise the pallas code
+# path (tests/test_parallel.py::test_fused_attention_under_sharded_mesh)
+# instead of silently falling back to einsum.
+FORCE_INTERPRET = False
+
+
 def _on_tpu() -> bool:
     if not _HAVE_PLTPU:
         return False
@@ -228,9 +303,12 @@ def fused_mha(
     B, L, H, D = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / (D ** 0.5)
-    # interpret=None: auto (real kernel on TPU, einsum fallback elsewhere);
-    # interpret=True: force kernel emulation (CPU tests); interpret=False:
-    # force the compiled kernel (raises off-TPU).
+    # interpret=None: auto (real kernel on TPU, einsum fallback elsewhere,
+    # emulated kernel if FORCE_INTERPRET); interpret=True: force kernel
+    # emulation (CPU tests); interpret=False: force the compiled kernel
+    # (raises off-TPU).
+    if interpret is None and FORCE_INTERPRET:
+        interpret = True
     use_kernel = _on_tpu() if interpret is None else True
     if not use_kernel or not supported(L, D):
         return _reference_mha(q, k, v, pad_mask, sm_scale, softmax_dtype)
