@@ -60,16 +60,14 @@ WARMUP_STEPS, BENCH_STEPS = 3, 50
 # path (325k -> 369k), and the fused-MHA pallas kernel
 # (ops/pallas_attention.py) worth another large step on top — its VMEM
 # softmax is f32, so it is MORE accurate than the bf16-softmax einsum
-# variant while being faster. ModelConfig's own defaults keep the
-# reference-parity einsum/f32 path; the knobs used are echoed in the JSON
-# line as "overrides".
-# fused_optimizer=True measured SLOWER end-to-end (422.6k vs 442.8k: the
-# ravel/unravel copies cost more than the per-leaf optax chain overhead
-# they replace), so it stays out of the tuned set — see PERF.md.
-# In-kernel bf16 softmax for the fused attention measured identical to
-# f32 end-to-end (437.5k vs 437.3k — the isolated -24% kernel-fwd win
-# vanishes behind the bwd's cast overhead), so the tuned set keeps the
-# more accurate f32.
+# variant while being faster. The knobs used are echoed in the JSON line
+# as "overrides".
+# The default config IS the tuned config as of r4 (conv_impl=xla and
+# attention_kernel=fused are the ModelConfig defaults, both chosen by
+# on-chip A/B). Knobs measured and NOT adopted (PERF.md): unfold conv
+# (-19%), fused_optimizer (-5%: ravel/unravel copies exceed the optax
+# chain overhead), in-kernel bf16 softmax (wash). The dict stays as the
+# mechanism for future A/Bs; the headline echoes it in the JSON line.
 TUNED_OVERRIDES = {"conv_impl": "xla", "attention_kernel": "fused"}
 
 
@@ -370,10 +368,13 @@ def run_ab():
     """A/B the performance knobs (README "Performance knobs"): one process
     per variant so each gets a clean backend; prints one JSON line each."""
     variants = [
-        {"conv_impl": "xla"},
-        {"conv_impl": "unfold"},
-        {"conv_impl": "pallas"},
-        {"conv_impl": "xla", "attention_softmax_dtype": "bfloat16"},
+        # every variant pins attention_kernel explicitly: the ModelConfig
+        # default is now "fused", so the einsum rows ARE the overrides
+        {"conv_impl": "xla", "attention_kernel": "einsum"},
+        {"conv_impl": "unfold", "attention_kernel": "einsum"},
+        {"conv_impl": "pallas", "attention_kernel": "einsum"},
+        {"conv_impl": "xla", "attention_kernel": "einsum",
+         "attention_softmax_dtype": "bfloat16"},
         {"conv_impl": "xla", "attention_kernel": "fused"},
         {"conv_impl": "pallas", "attention_kernel": "fused"},
     ]

@@ -189,19 +189,22 @@ class ModelConfig:
     # FLOPs so this mostly saves VPU/memory traffic)
     attention_softmax_dtype: str = "float32"
     use_reference_encoder: bool = True
-    # attention lowering for the dense path: "einsum" (XLA, materializes
-    # [B, H, L, L] scores in HBM) or "fused" (ops/pallas_attention.py — one
-    # VMEM pass per (batch, head), f32 softmax in-register; measured ~1.7x
-    # faster fwd+bwd at paper shapes). "fused" needs TPU hardware and
-    # L <= 1024 / head_dim <= 128; it falls back to einsum elsewhere.
-    # Parameter-free, so switchable on a restored checkpoint. Sharding:
-    # the kernel carries a custom_partitioning batch rule — without it
-    # GSPMD ALL-GATHERS the operands of a custom call. Validated: zero
-    # all-gathers + batch-sharded grads in the 8-device-mesh HLO
+    # attention lowering for the dense path: "fused" (default —
+    # ops/pallas_attention.py: one VMEM pass per (batch, head), f32
+    # softmax in-register; measured ~1.7x faster fwd+bwd at paper shapes)
+    # or "einsum" (XLA, materializes [B, H, L, L] scores in HBM — the
+    # literal transcription of the reference math). "fused" engages only
+    # on TPU hardware with L <= 1024 / head_dim <= 128 and falls back to
+    # einsum elsewhere (CPU tests and parity runs always exercise einsum
+    # numerics). Parameter-free, so switchable on a restored checkpoint.
+    # Sharding: the kernel carries a custom_partitioning batch rule —
+    # without it GSPMD ALL-GATHERS the operands of a custom call.
+    # Validated: zero all-gathers + batch-sharded grads in the
+    # 8-device-mesh HLO
     # (tests/test_parallel.py::test_fused_attention_batch_partitioned_*),
     # loss parity with einsum under the data-sharded train step, and
     # hardware execution on the 1-chip mesh (PERF.md).
-    attention_kernel: str = "einsum"
+    attention_kernel: str = "fused"
     # "dense" or "ring": ring engages sequence-parallel exact attention
     # (parallel/ring_attention.py) in the encoder/decoder FFT stacks for
     # inference beyond max_seq_len — build the model with a seq mesh

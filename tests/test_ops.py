@@ -282,7 +282,7 @@ def test_model_attention_kernel_knob():
     from tests.test_models import make_batch, tiny_config
     from speakingstyle_tpu.models.fastspeech2 import FastSpeech2
 
-    cfg_e = tiny_config()
+    cfg_e = tiny_config(attention_kernel="einsum")  # default is now fused
     cfg_f = dataclasses.replace(
         cfg_e, model=dataclasses.replace(cfg_e.model, attention_kernel="fused")
     )
