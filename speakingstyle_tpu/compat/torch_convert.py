@@ -226,7 +226,9 @@ def convert_hifigan(sd: Dict[str, np.ndarray]) -> Dict:
     )
     for n in range(n_res):
         block: Dict = {}
-        for branch in ("convs1", "convs2"):
+        # ResBlock1 stores dilated+plain conv pairs as convs1/convs2;
+        # ResBlock2 (the public V3 config) stores a single "convs" list
+        for branch in ("convs1", "convs2", "convs"):
             j = 0
             while f"resblocks.{n}.{branch}.{j}.weight" in sd:
                 block[f"{branch}_{j}"] = {

@@ -118,6 +118,30 @@ class ResBlock(nn.Module):
         return x
 
 
+class ResBlock2(nn.Module):
+    """The lighter MRF block of the HiFi-GAN V3 config (public
+    hifigan models.py ``ResBlock2``; V1/V2 and every config the reference
+    ships use resblock '1' — reference: hifigan/models.py:20-109): one
+    conv per dilation with a residual after each, instead of ResBlock1's
+    dilated+plain conv pairs."""
+
+    channels: int
+    kernel_size: int = 3
+    dilations: Tuple[int, ...] = (1, 3)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        for i, d in enumerate(self.dilations):
+            y = nn.leaky_relu(x, LRELU_SLOPE)
+            y = TorchConv1d(
+                self.channels, self.kernel_size, dilation=d, dtype=self.dtype,
+                name=f"convs_{i}",
+            )(y)
+            x = x + y
+        return x
+
+
 class Generator(nn.Module):
     """mel [B, T, n_mels] -> wav [B, T * prod(upsample_rates)]."""
 
@@ -126,10 +150,15 @@ class Generator(nn.Module):
     upsample_initial_channel: int = 512
     resblock_kernel_sizes: Sequence[int] = (3, 7, 11)
     resblock_dilation_sizes: Sequence[Tuple[int, ...]] = ((1, 3, 5), (1, 3, 5), (1, 3, 5))
+    resblock: str = "1"  # "1" (LJSpeech/universal, V1/V2) | "2" (V3)
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, mel):
+        # explicit mapping so a typo'd/int resblock raises instead of
+        # silently building the wrong topology (the error would otherwise
+        # surface only as a confusing param-tree mismatch at restore)
+        block_cls = {"1": ResBlock, "2": ResBlock2}[str(self.resblock)]
         x = TorchConv1d(
             self.upsample_initial_channel, 7, dtype=self.dtype, name="conv_pre"
         )(mel)
@@ -144,7 +173,7 @@ class Generator(nn.Module):
             for j, (rk, rd) in enumerate(
                 zip(self.resblock_kernel_sizes, self.resblock_dilation_sizes)
             ):
-                y = ResBlock(
+                y = block_cls(
                     ch, rk, tuple(rd), dtype=self.dtype,
                     name=f"resblocks_{i * num_kernels + j}",
                 )(x)
@@ -166,14 +195,12 @@ class Generator(nn.Module):
 
 
 def generator_from_config(config: dict, dtype=jnp.float32) -> Generator:
-    """Build from a hifigan config.json dict (reference: hifigan/config.json)."""
+    """Build from a hifigan config.json dict (reference: hifigan/config.json).
+    ``resblock: "1"`` (the reference's generator_{LJSpeech,universal}) and
+    ``"2"`` (the public V3 config) are both supported."""
     resblock = str(config.get("resblock", "1"))
-    if resblock != "1":
-        raise NotImplementedError(
-            f"resblock type {resblock!r} (ResBlock2, VCTK V2/V3 checkpoints) "
-            "is not supported; only resblock '1' (the reference's "
-            "generator_{LJSpeech,universal}) is implemented"
-        )
+    if resblock not in ("1", "2"):
+        raise ValueError(f"resblock must be '1' or '2', got {resblock!r}")
     return Generator(
         upsample_rates=tuple(config["upsample_rates"]),
         upsample_kernel_sizes=tuple(config["upsample_kernel_sizes"]),
@@ -182,6 +209,7 @@ def generator_from_config(config: dict, dtype=jnp.float32) -> Generator:
         resblock_dilation_sizes=tuple(
             tuple(d) for d in config["resblock_dilation_sizes"]
         ),
+        resblock=resblock,
         dtype=dtype,
     )
 

@@ -50,14 +50,35 @@ class TorchResBlock(tnn.Module):
         return x
 
 
+class TorchResBlock2(tnn.Module):
+    """Public hifigan models.py ResBlock2 (V2/V3 configs)."""
+
+    def __init__(self, ch, k, dils):
+        super().__init__()
+        self.convs = tnn.ModuleList(
+            [
+                weight_norm(tnn.Conv1d(ch, ch, k, 1, dilation=d, padding=(k * d - d) // 2))
+                for d in dils
+            ]
+        )
+
+    def forward(self, x):
+        for c in self.convs:
+            y = torch.nn.functional.leaky_relu(x, 0.1)
+            y = c(y)
+            x = x + y
+        return x
+
+
 class TorchGenerator(tnn.Module):
-    def __init__(self, cfg):
+    def __init__(self, cfg, resblock="1"):
         super().__init__()
         ch0 = cfg["upsample_initial_channel"]
         self.conv_pre = weight_norm(tnn.Conv1d(80, ch0, 7, 1, padding=3))
         self.ups = tnn.ModuleList()
         self.resblocks = tnn.ModuleList()
         self.num_kernels = len(cfg["resblock_kernel_sizes"])
+        block = TorchResBlock if resblock == "1" else TorchResBlock2
         for i, (u, k) in enumerate(
             zip(cfg["upsample_rates"], cfg["upsample_kernel_sizes"])
         ):
@@ -72,7 +93,7 @@ class TorchGenerator(tnn.Module):
             for rk, rd in zip(
                 cfg["resblock_kernel_sizes"], cfg["resblock_dilation_sizes"]
             ):
-                self.resblocks.append(TorchResBlock(ch, rk, rd))
+                self.resblocks.append(block(ch, rk, rd))
         self.conv_post = weight_norm(tnn.Conv1d(ch, 1, 7, 1, padding=3))
 
     def forward(self, mel):  # mel [B, 80, T]
@@ -112,14 +133,15 @@ def test_generator_from_config():
     assert wav.shape == (1, 10 * 256)
 
 
-def test_torch_parity():
+@pytest.mark.parametrize("resblock", ["1", "2"])
+def test_torch_parity(resblock):
     torch.manual_seed(0)
     cfg = {k: list(v) if isinstance(v, tuple) else v for k, v in SMALL.items()}
-    tgen = TorchGenerator(cfg).eval()
+    tgen = TorchGenerator(cfg, resblock=resblock).eval()
     sd = {k: v.detach().numpy() for k, v in tgen.state_dict().items()}
     params = convert_hifigan(sd)
 
-    gen = Generator(**SMALL)
+    gen = Generator(**SMALL, resblock=resblock)
     mel = np.random.default_rng(0).standard_normal((2, 17, 80)).astype(np.float32)
     wav_jax = np.asarray(gen.apply({"params": params}, jnp.asarray(mel)))
     with torch.no_grad():
