@@ -155,9 +155,14 @@ class Generator(nn.Module):
 
     @nn.compact
     def __call__(self, mel):
-        # explicit mapping so a typo'd/int resblock raises instead of
-        # silently building the wrong topology (the error would otherwise
-        # surface only as a confusing param-tree mismatch at restore)
+        # explicit check so a typo'd/int resblock raises clearly instead
+        # of silently building the wrong topology (the error would
+        # otherwise surface only as a param-tree mismatch at restore, or
+        # an inscrutable KeyError inside jit tracing)
+        if str(self.resblock) not in ("1", "2"):
+            raise ValueError(
+                f"resblock must be '1' or '2', got {self.resblock!r}"
+            )
         block_cls = {"1": ResBlock, "2": ResBlock2}[str(self.resblock)]
         x = TorchConv1d(
             self.upsample_initial_channel, 7, dtype=self.dtype, name="conv_pre"

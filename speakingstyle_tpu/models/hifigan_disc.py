@@ -16,10 +16,16 @@ discriminator suite natively in Flax:
 Losses are least-squares GAN + feature matching + mel-spectrogram L1
 (weights 1 / 2 / 45, reference: hifigan/train.py:122-156).
 
-Design deviation, documented: torch applies spectral_norm to the first MSD
-scale; spectral norm's power iteration is stateful and hostile to jit, and
-these discriminators exist only for from-scratch training (inference never
-loads them), so all scales use plain convs here.
+Spectral norm: torch applies spectral_norm to the first MSD scale
+(weight_norm to the rest). The first scale here uses ``nn.SpectralNorm``
+— power-iteration state (u, sigma) lives in the ``batch_stats``
+collection, updated when the caller passes ``update_stats=True`` (the
+vocoder train step does so on the discriminator pass, mirroring torch's
+per-forward update). The matricization differs from torch ([k*in, out]
+vs [out, in*k]) but is a transpose, so the spectral norm is identical.
+The remaining weight_norm sites stay plain convs (weight norm is a
+reparametrization folded at conversion; training dynamics deviation
+documented in README).
 """
 
 from typing import Dict, List, Sequence, Tuple
@@ -69,12 +75,17 @@ class PeriodDiscriminator(nn.Module):
 
 
 class ScaleDiscriminator(nn.Module):
-    """Grouped 1-D conv stack over (possibly pooled) raw audio."""
+    """Grouped 1-D conv stack over (possibly pooled) raw audio.
 
+    ``use_spectral_norm`` engages nn.SpectralNorm on every conv (torch's
+    first MSD scale, reference: hifigan/models.py:185); pass
+    ``update_stats=True`` to run a power-iteration step (train mode)."""
+
+    use_spectral_norm: bool = False
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
+    def __call__(self, x, update_stats: bool = False) -> Tuple[jnp.ndarray, List[jnp.ndarray]]:
         # (features, kernel, stride, groups) per layer — the reference's
         # DiscriminatorS geometry (hifigan/models.py:185-196)
         spec = [
@@ -88,16 +99,22 @@ class ScaleDiscriminator(nn.Module):
         ]
         B = x.shape[0]
         x = x[..., None].astype(self.dtype)
+
+        def conv(layer, y):
+            if self.use_spectral_norm:
+                return nn.SpectralNorm(layer)(y, update_stats=update_stats)
+            return layer(y)
+
         fmaps = []
         for i, (ch, k, s, g) in enumerate(spec):
-            x = nn.Conv(
+            x = conv(nn.Conv(
                 ch, kernel_size=(k,), strides=(s,), padding=[(k // 2, k // 2)],
                 feature_group_count=g, dtype=self.dtype, name=f"convs_{i}",
-            )(x)
+            ), x)
             x = nn.leaky_relu(x, LRELU_SLOPE)
             fmaps.append(x)
-        x = nn.Conv(1, kernel_size=(3,), padding=[(1, 1)], dtype=self.dtype,
-                    name="conv_post")(x)
+        x = conv(nn.Conv(1, kernel_size=(3,), padding=[(1, 1)], dtype=self.dtype,
+                         name="conv_post"), x)
         fmaps.append(x)
         return x.reshape(B, -1).astype(jnp.float32), fmaps
 
@@ -133,12 +150,16 @@ class MultiScaleDiscriminator(nn.Module):
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, y, y_hat):
+    def __call__(self, y, y_hat, update_stats: bool = False):
         outs_r, outs_g, fmaps_r, fmaps_g = [], [], [], []
         for i in range(self.n_scales):
-            d = ScaleDiscriminator(dtype=self.dtype, name=f"discriminators_{i}")
-            o_r, f_r = d(y)
-            o_g, f_g = d(y_hat)
+            # torch: spectral_norm on the first (unpooled) scale only
+            d = ScaleDiscriminator(
+                use_spectral_norm=(i == 0), dtype=self.dtype,
+                name=f"discriminators_{i}",
+            )
+            o_r, f_r = d(y, update_stats=update_stats)
+            o_g, f_g = d(y_hat, update_stats=update_stats)
             outs_r.append(o_r)
             outs_g.append(o_g)
             fmaps_r.append(f_r)

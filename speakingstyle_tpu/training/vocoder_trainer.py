@@ -48,6 +48,9 @@ class VocoderState(NamedTuple):
     gen_params: Dict
     mpd_params: Dict
     msd_params: Dict
+    # spectral-norm power-iteration state (u, sigma) of the first MSD
+    # scale — non-trainable, updated on each discriminator pass
+    msd_stats: Dict
     gen_opt: optax.OptState
     disc_opt: optax.OptState
 
@@ -85,12 +88,16 @@ def differentiable_mel(cfg: Config):
 
 
 def init_vocoder_state(
-    cfg: Config, hp: VocoderHParams, rng, gen_params: Optional[Dict] = None
+    cfg: Config, hp: VocoderHParams, rng, gen_params: Optional[Dict] = None,
+    gen: Optional[Generator] = None,
 ) -> Tuple[VocoderState, Generator, MultiPeriodDiscriminator, MultiScaleDiscriminator, optax.GradientTransformation, optax.GradientTransformation]:
     """Build models + optimizers; ``gen_params`` warm-starts the generator
-    (fine-tuning a converted checkpoint)."""
+    (fine-tuning a converted checkpoint). Pass ``gen`` (e.g. from
+    ``hifigan.generator_from_config`` on the checkpoint's config.json) when
+    fine-tuning a non-default topology — V3/ResBlock2, different upsample
+    rates — so the module matches the warm-start params."""
     n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
-    gen = Generator()
+    gen = gen if gen is not None else Generator()
     mpd = MultiPeriodDiscriminator()
     msd = MultiScaleDiscriminator()
     k1, k2, k3 = jax.random.split(rng, 3)
@@ -100,7 +107,9 @@ def init_vocoder_state(
         gen_params = gen.init(k1, jnp.zeros((1, seg // hop, n_mels)))["params"]
     wav0 = jnp.zeros((1, seg))
     mpd_params = mpd.init(k2, wav0, wav0)["params"]
-    msd_params = msd.init(k3, wav0, wav0)["params"]
+    msd_vars = msd.init(k3, wav0, wav0)
+    msd_params = msd_vars["params"]
+    msd_stats = msd_vars["batch_stats"]
 
     schedule = optax.exponential_decay(
         hp.learning_rate, hp.lr_decay_steps, hp.lr_decay, staircase=True
@@ -117,6 +126,7 @@ def init_vocoder_state(
         gen_params=gen_params,
         mpd_params=mpd_params,
         msd_params=msd_params,
+        msd_stats=msd_stats,
         gen_opt=gen_tx.init(gen_params),
         disc_opt=disc_tx.init({"mpd": mpd_params, "msd": msd_params}),
     )
@@ -137,11 +147,20 @@ def make_vocoder_train_step(cfg: Config, hp: VocoderHParams, gen, mpd, msd,
 
         def disc_loss_fn(dparams):
             pr, pg, _, _ = mpd.apply({"params": dparams["mpd"]}, wavs, y_hat_d)
-            sr_, sg, _, _ = msd.apply({"params": dparams["msd"]}, wavs, y_hat_d)
-            return discriminator_loss(pr, pg) + discriminator_loss(sr_, sg)
+            # power-iteration update (torch spectral_norm updates u on
+            # every train-mode forward); u/sigma are non-trainable, so
+            # they ride out of the grad as aux
+            (sr_, sg, _, _), new_stats = msd.apply(
+                {"params": dparams["msd"], "batch_stats": state.msd_stats},
+                wavs, y_hat_d, update_stats=True, mutable=["batch_stats"],
+            )
+            loss = discriminator_loss(pr, pg) + discriminator_loss(sr_, sg)
+            return loss, new_stats["batch_stats"]
 
         dparams = {"mpd": state.mpd_params, "msd": state.msd_params}
-        d_loss, d_grads = jax.value_and_grad(disc_loss_fn)(dparams)
+        (d_loss, msd_stats), d_grads = jax.value_and_grad(
+            disc_loss_fn, has_aux=True
+        )(dparams)
         d_updates, disc_opt = disc_tx.update(d_grads, state.disc_opt, dparams)
         dparams = optax.apply_updates(dparams, d_updates)
 
@@ -154,17 +173,26 @@ def make_vocoder_train_step(cfg: Config, hp: VocoderHParams, gen, mpd, msd,
             T = min(mel_g.shape[1], mels.shape[1])
             loss_mel = jnp.mean(jnp.abs(mel_r[:, :T] - mel_g[:, :T]))
             _, pg, pf_r, pf_g = mpd.apply({"params": dparams["mpd"]}, wavs, y_g)
-            _, sg, sf_r, sf_g = msd.apply({"params": dparams["msd"]}, wavs, y_g)
+            # update_stats=True, like torch: spectral_norm recomputes sigma
+            # (and steps u) on EVERY train-mode forward, including the
+            # generator pass — and the MSD params just changed in the
+            # discriminator optimizer step, so stale sigma would normalize
+            # W_new by sigma(W_old)
+            (_, sg, sf_r, sf_g), new_stats = msd.apply(
+                {"params": dparams["msd"], "batch_stats": msd_stats},
+                wavs, y_g, update_stats=True, mutable=["batch_stats"],
+            )
             loss_adv = generator_adversarial_loss(pg) + generator_adversarial_loss(sg)
             loss_fm = feature_matching_loss(pf_r, pf_g) + feature_matching_loss(
                 sf_r, sf_g
             )
             total = loss_adv + loss_fm + hp.mel_loss_weight * loss_mel
-            return total, (loss_mel, loss_adv, loss_fm)
+            return total, (loss_mel, loss_adv, loss_fm,
+                           new_stats["batch_stats"])
 
-        (g_loss, (loss_mel, loss_adv, loss_fm)), g_grads = jax.value_and_grad(
-            gen_loss_fn, has_aux=True
-        )(state.gen_params)
+        (g_loss, (loss_mel, loss_adv, loss_fm, msd_stats)), g_grads = (
+            jax.value_and_grad(gen_loss_fn, has_aux=True)(state.gen_params)
+        )
         g_updates, gen_opt = gen_tx.update(
             g_grads, state.gen_opt, state.gen_params
         )
@@ -175,6 +203,7 @@ def make_vocoder_train_step(cfg: Config, hp: VocoderHParams, gen, mpd, msd,
             gen_params=gen_params,
             mpd_params=dparams["mpd"],
             msd_params=dparams["msd"],
+            msd_stats=msd_stats,
             gen_opt=gen_opt,
             disc_opt=disc_opt,
         )

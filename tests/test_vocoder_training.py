@@ -36,8 +36,8 @@ def test_period_discriminator_shapes():
 def test_scale_discriminator_shapes():
     msd = MultiScaleDiscriminator(n_scales=2)
     y = jnp.asarray(np.random.default_rng(0).standard_normal((2, SEG)), jnp.float32)
-    params = msd.init(jax.random.PRNGKey(0), y, y)["params"]
-    outs_r, _, fmaps_r, _ = msd.apply({"params": params}, y, y)
+    variables = msd.init(jax.random.PRNGKey(0), y, y)
+    outs_r, _, fmaps_r, _ = msd.apply(variables, y, y)
     assert len(outs_r) == 2
     assert all(len(f) == 8 for f in fmaps_r)  # 7 conv + post
 
@@ -231,3 +231,39 @@ def test_get_vocoder_rejects_full_state_msgpack(tmp_path):
     # the sidecar still loads fine
     gen2, params2 = get_vocoder(cfg, gen_path)
     assert params2 is not None
+
+
+@pytest.mark.slow
+def test_spectral_norm_sigma_converges_to_true_norm():
+    """The first MSD scale's nn.SpectralNorm: after enough power-iteration
+    updates, stored sigma matches the true largest singular value of the
+    (matricized) conv kernel — the property torch.nn.utils.spectral_norm
+    guarantees (reference: hifigan/models.py:185 norm_f selection)."""
+    from speakingstyle_tpu.models.hifigan_disc import ScaleDiscriminator
+
+    d = ScaleDiscriminator(use_spectral_norm=True)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((1, 128)), jnp.float32)
+    variables = d.init(jax.random.PRNGKey(0), x)
+    for _ in range(300):  # power iteration to convergence
+        _, updates = d.apply(x=x, update_stats=True, mutable=["batch_stats"],
+                             variables=variables)
+        variables = {**variables, "batch_stats": updates["batch_stats"]}
+
+    from flax.traverse_util import flatten_dict
+
+    params = flatten_dict(variables["params"], sep="/")
+    stats = flatten_dict(variables["batch_stats"], sep="/")
+    checked = 0
+    cands = [p for p in params if p.endswith("/kernel")]
+    for k, sigma in stats.items():
+        if not k.endswith("/sigma"):
+            continue
+        # pair sigma with its conv's kernel by the conv's scope name
+        match = [p for p in cands if p.split("/")[-2] in k]
+        if not match:
+            continue
+        w = np.asarray(params[match[0]])
+        true_sigma = np.linalg.svd(w.reshape(-1, w.shape[-1]), compute_uv=False)[0]
+        np.testing.assert_allclose(float(sigma), true_sigma, rtol=1e-2)
+        checked += 1
+    assert checked >= 2, "no sigma/kernel pairs matched"
