@@ -56,12 +56,12 @@ WARMUP_STEPS, BENCH_STEPS = 3, 50
 
 # The headline measures the TPU-tuned training config (README "Performance
 # knobs"): the r4 on-chip A/B measured conv_impl=xla fastest end-to-end
-# (325k vs unfold's 265k frames/s), bf16 softmax worth +13% on the einsum
-# path (325k -> 369k), and the fused-MHA pallas kernel
-# (ops/pallas_attention.py) worth another large step on top — its VMEM
-# softmax is f32, so it is MORE accurate than the bf16-softmax einsum
-# variant while being faster. The knobs used are echoed in the JSON line
-# as "overrides".
+# (330k vs unfold's 272k frames/s on the final matrix re-run — PERF.md),
+# bf16 softmax worth +14% on the einsum path, and the fused-MHA pallas
+# kernel (ops/pallas_attention.py) worth another large step on top
+# (443k) — its VMEM softmax is f32, so it is MORE accurate than the
+# bf16-softmax einsum variant while being faster. The knobs used are
+# echoed in the JSON line as "overrides".
 # The default config IS the tuned config as of r4 (conv_impl=xla and
 # attention_kernel=fused are the ModelConfig defaults, both chosen by
 # on-chip A/B). Knobs measured and NOT adopted (PERF.md): unfold conv
@@ -105,6 +105,24 @@ def make_batch(n_mels: int, rng):
 _T0 = time.monotonic()
 
 
+def _is_tpu(dev) -> bool:
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    return "tpu" in dev.platform.lower() or "tpu" in kind
+
+
+def _require_tpu() -> None:
+    """Fail loudly if the backend fell back to CPU (sick tunnel) — for the
+    interactive modes; the guarded headline emits a JSON error instead."""
+    import jax
+
+    d = jax.devices()[0]
+    if not _is_tpu(d):
+        raise RuntimeError(
+            f"no TPU: backend is {d.platform!r} (tunnel down?) — numbers "
+            "from this host's CPU would be meaningless"
+        )
+
+
 def _mark(msg: str) -> None:
     """Timestamped stderr breadcrumb.
 
@@ -143,6 +161,24 @@ def main(report_flops: bool = False, profile: bool = False,
     _mark("acquiring devices (tunneled-TPU backend init hangs here when sick)")
     devs = jax.devices()
     _mark(f"devices acquired: {devs}")
+    if not _is_tpu(devs[0]):
+        # A sick tunnel can fail device init and silently fall back to the
+        # CPU backend — observed once in an --ab sweep, which recorded
+        # 17k frames/s (exactly CPU speed) as if it were a TPU number.
+        # A wrong-device measurement is worse than no measurement.
+        out = {
+            "metric": "train_step_flops" if report_flops
+                      else "train_mel_frames_per_sec",
+            "value": None,
+            "unit": "FLOP/step" if report_flops else "mel-frames/sec/chip",
+            "vs_baseline": None,
+            "error": f"no TPU: backend fell back to {devs[0].platform!r} "
+                     "(tunnel down?) — refusing to record a CPU number",
+        }
+        if overrides:
+            out["overrides"] = overrides
+        print(json.dumps(out))
+        return
     cfg = Config()
     if overrides:
         cfg = _apply_overrides(cfg, overrides)
@@ -241,6 +277,7 @@ def run_breakdown():
         "jax_compilation_cache_dir",
         os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
     )
+    _require_tpu()
     cfg = _apply_overrides(Config(), TUNED_OVERRIDES)
     m = cfg.model
     dtype = jnp.dtype(m.compute_dtype)
@@ -309,6 +346,7 @@ def run_infer():
         "jax_compilation_cache_dir",
         os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
     )
+    _require_tpu()
     cfg = _apply_overrides(Config(), TUNED_OVERRIDES)
     rng = np.random.default_rng(0)
     hop, sr = 256, 22050
