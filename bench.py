@@ -509,7 +509,10 @@ def _run_guarded():
 
 if __name__ == "__main__":
     if "--flops" in sys.argv:
-        main(report_flops=True)
+        ov = None
+        if "--overrides" in sys.argv:
+            ov = json.loads(sys.argv[sys.argv.index("--overrides") + 1])
+        main(report_flops=True, overrides=ov)
     elif "--breakdown" in sys.argv:
         run_breakdown()
     elif "--infer" in sys.argv:
