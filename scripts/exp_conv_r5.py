@@ -1,0 +1,130 @@
+"""Round-5 single-op conv experiment: does the pallas fused conv WIN for
+training once its backward is analytic (no forward recompute)?
+
+Measures fwd+bwd (grads wrt x, w, and epilogue params) and fwd-only time
+for the model's heavy conv shapes (PERF.md breakdown: ref-enc conv stack
+8.3 ms, decoder k=9 FFN inside the 24.2 ms decoder, postnet 5.4 ms):
+
+  * "xla"              — lax.conv + bias (+ReLU +LN) composed, XLA autodiff
+  * "pallas-analytic"  — fused kernel fwd, r5 analytic backward
+  * "pallas-recompute" — fused kernel fwd, pre-r5 recompute backward
+
+Timing per the repo discipline (PERF.md "Timing methodology"): explicit
+device->host scalar read as the sync point, 50 iterations.
+
+Usage: python scripts/exp_conv_r5.py [--fwd-only]
+"""
+
+import os
+import sys
+import time
+
+# repo-root import bootstrap: PYTHONPATH at interpreter startup breaks the
+# tunneled-TPU ("axon") jax plugin discovery, so extend sys.path here instead
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 ".jax_cache"),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+import speakingstyle_tpu.ops.pallas_conv as pc
+from speakingstyle_tpu.ops.pallas_conv import fused_conv1d, fused_conv_relu_ln
+
+ITERS = 50
+DT = jnp.bfloat16
+
+
+def timeit(fn, *args):
+    out = fn(*args)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(leaf.ravel()[0])  # D2H sync after compile+warm
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn(*args)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(leaf.ravel()[0])
+    return (time.perf_counter() - t0) / ITERS * 1e3
+
+
+def xla_fused(x, w, b, s, sb, relu, ln):
+    y = jax.lax.conv_general_dilated(
+        x, w, (1,), "SAME", dimension_numbers=("NWC", "WIO", "NWC")
+    ) + b
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    if ln:
+        yf = y.astype(jnp.float32)
+        mean = yf.mean(-1, keepdims=True)
+        var = yf.var(-1, keepdims=True)
+        yf = (yf - mean) * jax.lax.rsqrt(var + pc.LN_EPS)
+        y = (yf * s + sb).astype(y.dtype)
+    return y
+
+
+def pallas_fused(x, w, b, s, sb, relu, ln):
+    if ln:
+        return fused_conv_relu_ln(x, w, b, s, sb)
+    return fused_conv1d(x, w, b, relu=relu)
+
+
+def main():
+    fwd_only = "--fwd-only" in sys.argv
+    from speakingstyle_tpu.ops.pallas_attention import _on_tpu
+
+    assert _on_tpu(), f"not a TPU: {jax.devices()[0]}"
+
+    rng = np.random.default_rng(0)
+    # (name, B, T, cin, cout, K, relu, ln)
+    shapes = [
+        ("refenc_c0 80->1024 k3 +relu+ln", 48, 600, 80, 1024, 3, True, True),
+        ("refenc_c12 1024->1024 k3 +relu+ln", 48, 600, 1024, 1024, 3, True, True),
+        ("ffn_w1_k3 256->1024 +relu", 48, 600, 256, 1024, 3, True, False),
+        ("ffn_w2_k3 1024->256", 48, 600, 1024, 256, 3, False, False),
+        ("dec_w1_k9 256->1024 +relu", 48, 600, 256, 1024, 9, True, False),
+        ("postnet_k5 512->512", 48, 600, 512, 512, 5, False, False),
+    ]
+    for name, B, T, cin, cout, K, relu, ln in shapes:
+        x = jnp.asarray(rng.standard_normal((B, T, cin)), DT)
+        w = jnp.asarray(rng.standard_normal((K, cin, cout)) * 0.02, DT)
+        b = jnp.zeros((cout,), DT)
+        s = jnp.ones((cout,), DT)
+        sb = jnp.zeros((cout,), DT)
+
+        res = {}
+        for label, fn in (("xla", xla_fused), ("pallas", pallas_fused)):
+
+            def loss(x_, w_, b_, s_, sb_, fn=fn):
+                return jnp.sum(
+                    fn(x_, w_, b_, s_, sb_, relu, ln).astype(jnp.float32) ** 2
+                )
+
+            if fwd_only:
+                res[label] = timeit(jax.jit(loss), x, w, b, s, sb)
+            elif label == "pallas":
+                for mode in ("analytic", "recompute"):
+                    pc.BWD_MODE = mode
+                    res[f"pallas-{mode}"] = timeit(
+                        jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3, 4))),
+                        x, w, b, s, sb,
+                    )
+                pc.BWD_MODE = "analytic"
+            else:
+                res[label] = timeit(
+                    jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3, 4))),
+                    x, w, b, s, sb,
+                )
+        row = "  ".join(f"{k}={v:7.3f}ms" for k, v in res.items())
+        print(f"{name:38s} {row}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

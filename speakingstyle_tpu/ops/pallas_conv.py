@@ -19,12 +19,24 @@ into VMEM scratch — overlapping windows are not expressible as a blocked
 (max: k=9, 256->1024 bf16 = 4.7 MB).
 
 Differentiation: ``fused_conv1d`` / ``fused_conv_relu_ln`` carry a
-``jax.custom_vjp`` whose backward recomputes through the pure-jnp
-reference implementation — the same rematerialization
-``train.sharding.remat`` already applies to these blocks, so the training
-cost is unchanged and correctness is exact
-(tests/test_ops.py::test_conv1d_impl_parity,
-::test_fused_conv_relu_ln_matches_composed).
+``jax.custom_vjp`` with an **analytic backward** (the r5 fix for why
+conv=pallas lost the r4 training A/B — its old backward recomputed the
+whole forward through the im2col reference path, itself 19% slower than
+the conv emitter):
+
+* epilogue backward (LayerNorm + ReLU) runs in plain jnp from a saved
+  post-ReLU residual (the kernel's second output when ``ln`` is on;
+  the primal output itself when only ReLU is on — ``y > 0`` IS the
+  ReLU mask) — all elementwise/reduction work XLA fuses;
+* dx/dw/db come from ``jax.vjp`` of the *linear* ``lax.conv`` — conv is
+  linear in (x, w), so this stores nothing and recomputes nothing; XLA
+  lowers the transposed convs with the same emitter the "xla" impl uses
+  (93–140 TF/s measured, PERF.md).
+
+Gradient parity vs the composed reference:
+tests/test_ops.py::test_conv1d_impl_parity,
+::test_fused_conv_relu_ln_matches_composed. Set ``BWD_MODE="recompute"``
+(module global) to A/B the old recompute path.
 
 Set ``interpret=True`` (or run on a non-TPU backend, which forces it) to
 emulate the kernel — CPU tests use this.
@@ -48,24 +60,40 @@ except ImportError:  # pragma: no cover
 LN_EPS = 1e-5
 
 
-def _reference_fused(x, kernel, bias, ln_scale, ln_bias, dilation, relu):
-    """Pure-jnp spec of the fused op (also the custom_vjp backward path)."""
+def _reference_fused_parts(x, kernel, bias, ln_scale, ln_bias, dilation,
+                           relu):
+    """Pure-jnp spec of the fused op. Returns (y, act) where act is the
+    post-ReLU / pre-LayerNorm intermediate (== y when there is no LN) —
+    the residual the analytic backward needs."""
     from speakingstyle_tpu.ops.conv import conv1d_unfold
 
     y = conv1d_unfold(x, kernel, bias, dilation=dilation)
     if relu:
         y = jnp.maximum(y, 0.0)
+    act = y
     if ln_scale is not None:
         yf = y.astype(jnp.float32)
         mean = yf.mean(axis=-1, keepdims=True)
         var = yf.var(axis=-1, keepdims=True)
         yf = (yf - mean) * jax.lax.rsqrt(var + LN_EPS)
         y = (yf * ln_scale + ln_bias).astype(y.dtype)
-    return y
+    return y, act
 
 
-def _kernel(x_hbm, w_ref, b_ref, s_ref, sb_ref, out_ref, x_vmem, sem, *,
-            tile, copy_len, taps, dilation, relu, ln):
+def _reference_fused(x, kernel, bias, ln_scale, ln_bias, dilation, relu):
+    """Pure-jnp spec of the fused op (also the recompute-mode backward)."""
+    return _reference_fused_parts(
+        x, kernel, bias, ln_scale, ln_bias, dilation, relu
+    )[0]
+
+
+def _kernel(x_hbm, w_ref, b_ref, s_ref, sb_ref, *refs,
+            tile, copy_len, taps, dilation, relu, ln, want_act):
+    if want_act:
+        out_ref, act_ref, x_vmem, sem = refs
+    else:
+        out_ref, x_vmem, sem = refs
+        act_ref = None
     b = pl.program_id(0)
     t = pl.program_id(1)
     # copy_len is (tile + span - 1) rounded up to the sublane tiling (8):
@@ -87,6 +115,15 @@ def _kernel(x_hbm, w_ref, b_ref, s_ref, sb_ref, out_ref, x_vmem, sem, *,
     if relu:
         acc = jnp.maximum(acc, 0.0)
     if ln:
+        # round to the storage dtype BEFORE the LN stats: this is exactly
+        # what the unfused reference does (bf16 ReLU output -> f32 LN), and
+        # it makes the backward's stats (recomputed from the saved act)
+        # bit-consistent with the forward's
+        acc = acc.astype(out_ref.dtype).astype(jnp.float32)
+    if want_act:
+        # post-ReLU / pre-LN residual for the analytic backward
+        act_ref[0] = acc.astype(act_ref.dtype)
+    if ln:
         mean = acc.mean(axis=-1, keepdims=True)
         var = ((acc - mean) ** 2).mean(axis=-1, keepdims=True)
         acc = (acc - mean) * jax.lax.rsqrt(var + LN_EPS)
@@ -98,7 +135,7 @@ LANE = 128  # Mosaic lane tiling: channel dims in DMA slices must align
 
 
 def _fused_fwd_pallas(x, kernel, bias, ln_scale, ln_bias, dilation, relu,
-                      tile, interpret):
+                      tile, interpret, want_act=False):
     B, T, cin = x.shape
     K, _, cout = kernel.shape
     span = (K - 1) * dilation + 1
@@ -137,11 +174,15 @@ def _fused_fwd_pallas(x, kernel, bias, ln_scale, ln_bias, dilation, relu,
         ln_scale = jnp.zeros((cout,), x.dtype)
         ln_bias = jnp.zeros((cout,), x.dtype)
 
+    # the act residual only differs from the output when LN runs after it
+    want_act = want_act and ln
     kern = functools.partial(
         _kernel, tile=tile, copy_len=copy_len, taps=K, dilation=dilation,
-        relu=relu, ln=ln,
+        relu=relu, ln=ln, want_act=want_act,
     )
     vec = lambda v: v.reshape(1, cout)
+    block = pl.BlockSpec((1, tile, cout), lambda b, t: (b, t, 0))
+    shape = jax.ShapeDtypeStruct((B, t_pad, cout), x.dtype)
     out = pl.pallas_call(
         kern,
         grid=(B, n_t),
@@ -152,14 +193,16 @@ def _fused_fwd_pallas(x, kernel, bias, ln_scale, ln_bias, dilation, relu,
             pl.BlockSpec((1, cout), lambda b, t: (0, 0)),
             pl.BlockSpec((1, cout), lambda b, t: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, tile, cout), lambda b, t: (b, t, 0)),
-        out_shape=jax.ShapeDtypeStruct((B, t_pad, cout), x.dtype),
+        out_specs=[block, block] if want_act else block,
+        out_shape=[shape, shape] if want_act else shape,
         scratch_shapes=[
             pltpu.VMEM((copy_len, cin), x.dtype),
             pltpu.SemaphoreType.DMA,
         ],
         interpret=interpret,
     )(xp, kernel, vec(bias), vec(ln_scale), vec(ln_bias))
+    if want_act:
+        return tuple(o[:, :T, :cout_orig] for o in out)
     return out[:, :T, :cout_orig]
 
 
@@ -186,19 +229,24 @@ def _use_interpret() -> bool:
     return not ("tpu" in dev.platform.lower() or "tpu" in kind)
 
 
+def _use_reference(ln_scale, kernel) -> bool:
+    """Fall back to the pure-jnp reference when there is no pallas-TPU
+    module at all (even the interpreter path uses its DMA/scratch
+    primitives), or for an in-kernel LayerNorm over a non-lane-aligned
+    channel count (the kernel's mean/var would average the alignment
+    padding). Single source of truth for BOTH the primal and the vjp fwd
+    rule — they must agree or grad-time and inference-time forwards drift."""
+    return not _HAVE_PLTPU or (
+        ln_scale is not None and kernel.shape[-1] % LANE != 0
+    )
+
+
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8)
 )
 def _fused(x, kernel, bias, ln_scale, ln_bias, dilation, relu, tile,
            interpret):
-    if not _HAVE_PLTPU or (
-        ln_scale is not None and kernel.shape[-1] % LANE != 0
-    ):
-        # No pallas-TPU module at all (even the interpreter path uses its
-        # DMA/scratch primitives), or an in-kernel LayerNorm over a
-        # non-lane-aligned channel count (the kernel's mean/var would
-        # average the alignment padding) — run the mathematically
-        # identical reference implementation instead of failing later.
+    if _use_reference(ln_scale, kernel):
         return _reference_fused(
             x, kernel, bias, ln_scale, ln_bias, dilation, relu
         )
@@ -207,25 +255,89 @@ def _fused(x, kernel, bias, ln_scale, ln_bias, dilation, relu, tile,
     )
 
 
+# "analytic" (default): epilogue backward from the saved post-ReLU
+# residual + linear-conv vjp for dx/dw. "recompute": the pre-r5 behavior
+# (full forward recompute through the im2col reference) — kept for A/B.
+BWD_MODE = "analytic"
+
+
 def _fused_fwd(x, kernel, bias, ln_scale, ln_bias, dilation, relu, tile,
                interpret):
-    y = _fused(x, kernel, bias, ln_scale, ln_bias, dilation, relu, tile,
-               interpret)
-    return y, (x, kernel, bias, ln_scale, ln_bias)
+    if BWD_MODE != "analytic":
+        y = _fused(x, kernel, bias, ln_scale, ln_bias, dilation, relu,
+                   tile, interpret)
+        return y, (x, kernel, bias, ln_scale, ln_bias, None)
+    if _use_reference(ln_scale, kernel):
+        y, act = _reference_fused_parts(
+            x, kernel, bias, ln_scale, ln_bias, dilation, relu
+        )
+    elif ln_scale is not None:
+        y, act = _fused_fwd_pallas(
+            x, kernel, bias, ln_scale, ln_bias, dilation, relu, tile,
+            interpret, want_act=True,
+        )
+    else:
+        # without LN the primal output itself is the residual: y > 0 IS
+        # the ReLU mask (and with no ReLU either, no residual is read)
+        y = _fused_fwd_pallas(
+            x, kernel, bias, ln_scale, ln_bias, dilation, relu, tile,
+            interpret,
+        )
+        act = y
+    return y, (x, kernel, bias, ln_scale, ln_bias, act)
 
 
 def _fused_bwd(dilation, relu, tile, interpret, res, g):
-    x, kernel, bias, ln_scale, ln_bias = res
-    wrt = (x, kernel, bias, ln_scale, ln_bias)
+    x, kernel, bias, ln_scale, ln_bias, act = res
+    if BWD_MODE != "analytic":
+        wrt = (x, kernel, bias, ln_scale, ln_bias)
 
-    def f(x_, k_, b_, s_, sb_):
-        return _reference_fused(x_, k_, b_, s_, sb_, dilation, relu)
+        def f(x_, k_, b_, s_, sb_):
+            return _reference_fused(x_, k_, b_, s_, sb_, dilation, relu)
 
-    _, vjp = jax.vjp(f, *wrt)
-    grads = vjp(g)
-    if res[3] is None:
-        grads = grads[:3] + (None, None)
-    return grads
+        _, vjp = jax.vjp(f, *wrt)
+        grads = vjp(g)
+        if ln_scale is None:
+            grads = grads[:3] + (None, None)
+        return grads
+
+    gf = g.astype(jnp.float32)
+    if ln_scale is not None:
+        # LayerNorm backward from the saved pre-LN input (stats recomputed
+        # — two cheap fused reductions, no conv recompute)
+        af = act.astype(jnp.float32)
+        mean = af.mean(axis=-1, keepdims=True)
+        var = af.var(axis=-1, keepdims=True)
+        rstd = jax.lax.rsqrt(var + LN_EPS)
+        norm = (af - mean) * rstd
+        d_scale = (gf * norm).sum(axis=(0, 1)).astype(ln_scale.dtype)
+        d_lnbias = gf.sum(axis=(0, 1)).astype(ln_bias.dtype)
+        dnorm = gf * ln_scale.astype(jnp.float32)
+        da = (
+            dnorm
+            - dnorm.mean(axis=-1, keepdims=True)
+            - norm * (dnorm * norm).mean(axis=-1, keepdims=True)
+        ) * rstd
+    else:
+        d_scale = d_lnbias = None
+        da = gf
+    if relu:
+        da = da * (act > 0)
+    dz = da.astype(x.dtype)
+    db = None if bias is None else da.sum(axis=(0, 1)).astype(bias.dtype)
+
+    # conv is linear in (x, w): vjp through it stores nothing and
+    # recomputes nothing; XLA emits the transposed convs directly.
+    def conv_lin(x_, k_):
+        return jax.lax.conv_general_dilated(
+            x_, k_, window_strides=(1,), padding="SAME",
+            rhs_dilation=(dilation,),
+            dimension_numbers=("NWC", "WIO", "NWC"),
+        )
+
+    _, vjp = jax.vjp(conv_lin, x, kernel)
+    dx, dw = vjp(dz)
+    return dx, dw, db, d_scale, d_lnbias
 
 
 _fused.defvjp(_fused_fwd, _fused_bwd)

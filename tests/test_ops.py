@@ -185,6 +185,46 @@ def test_fused_conv_relu_ln_matches_composed():
         np.testing.assert_allclose(np.asarray(gg), np.asarray(gw), atol=1e-4)
 
 
+def test_fused_conv_relu_ln_grads_lane_aligned():
+    """Gradient parity at a lane-aligned (cout=128) width: this is the
+    config where the REAL kernel path runs (the cout=16 test above trips
+    the lane-alignment fallback to the jnp reference), so it exercises the
+    want_act second pallas output + analytic backward wiring in CI."""
+    import jax
+
+    from speakingstyle_tpu.ops.pallas_conv import (
+        _reference_fused,
+        fused_conv_relu_ln,
+    )
+
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.standard_normal((2, 24, 128)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((3, 128, 128)) * 0.05, jnp.float32)
+    b = jnp.asarray(rng.standard_normal(128) * 0.1, jnp.float32)
+    s = jnp.asarray(rng.standard_normal(128), jnp.float32)
+    sb = jnp.asarray(rng.standard_normal(128), jnp.float32)
+
+    got = fused_conv_relu_ln(x, w, b, s, sb, interpret=True)
+    want = _reference_fused(x, w, b, s, sb, 1, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5)
+
+    g_got = jax.grad(
+        lambda a: jnp.sum(
+            fused_conv_relu_ln(a[0], a[1], a[2], a[3], a[4], interpret=True)
+            ** 2
+        )
+    )((x, w, b, s, sb))
+    g_want = jax.grad(
+        lambda a: jnp.sum(
+            _reference_fused(a[0], a[1], a[2], a[3], a[4], 1, True) ** 2
+        )
+    )((x, w, b, s, sb))
+    for gg, gw in zip(g_got, g_want):
+        np.testing.assert_allclose(
+            np.asarray(gg), np.asarray(gw), rtol=2e-4, atol=2e-4
+        )
+
+
 def test_conv1d_module_tree_matches_nn_conv():
     """Conv1d's param entry is nn.Conv-identical for every impl."""
     import flax.linen as nn
