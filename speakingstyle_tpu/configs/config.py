@@ -211,11 +211,27 @@ class ModelConfig:
     # (models/factory.build_model(..., seq_mesh=...)); sequence lengths
     # must divide by the mesh's seq axis.
     attention_impl: str = "dense"
+    # dropout mask generation (ops/dropout.py): "hash" (default — salted
+    # murmur3 counter hash, pure elementwise so XLA fuses it into the
+    # consumer; zero RNG-bit HBM traffic; measured -71..-286 us/site vs
+    # bernoulli on v5e, scripts/exp_dropout_r5.py), "bernoulli"
+    # (jax.random, what nn.Dropout does — the reference-parity RNG
+    # stream), or "bits16" (raw 16-bit threshold compare; measured worse
+    # than bernoulli — the bitcast defeats fusion; kept as the recorded
+    # negative). Mask distribution is identical across impls (inverted
+    # dropout, P(keep)=1-rate); only the PRNG stream differs, so this is
+    # switchable on a restored checkpoint.
+    dropout_impl: str = "hash"
 
     def __post_init__(self):
         if self.attention_impl not in ("dense", "ring"):
             raise ValueError(
                 f"attention_impl must be dense|ring, got {self.attention_impl}"
+            )
+        if self.dropout_impl not in ("bernoulli", "bits16", "hash"):
+            raise ValueError(
+                f"dropout_impl must be bernoulli|bits16|hash, "
+                f"got {self.dropout_impl}"
             )
         if self.conv_impl not in ("xla", "unfold", "pallas"):
             raise ValueError(

@@ -58,6 +58,7 @@ def reference_encoder_from_config(
         dtype=jnp.dtype(m.compute_dtype),
         softmax_dtype=jnp.dtype(m.attention_softmax_dtype),
         attention_kernel=m.attention_kernel,
+        dropout_impl=m.dropout_impl,
         **({"name": name} if name is not None else {}),
     )
 
@@ -90,6 +91,7 @@ def fft_stack_from_config(
         softmax_dtype=jnp.dtype(m.attention_softmax_dtype),
         attention_kernel=m.attention_kernel,
         seq_mesh=seq_mesh,
+        dropout_impl=m.dropout_impl,
         **({"name": name} if name is not None else {}),
     )
 

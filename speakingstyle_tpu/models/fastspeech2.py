@@ -100,6 +100,7 @@ class FastSpeech2(nn.Module):
             dropout=cfg.variance_predictor.dropout,
             conv_impl=conv_impl,
             dtype=dtype,
+            dropout_impl=cfg.dropout_impl,
             name="variance_adaptor",
         )(
             x,
@@ -147,6 +148,7 @@ class FastSpeech2(nn.Module):
             n_mel_channels=self.config.preprocess.preprocessing.mel.n_mel_channels,
             conv_impl=conv_impl,
             dtype=dtype,
+            dropout_impl=cfg.dropout_impl,
             name="postnet",
         )(postnet_in, deterministic=deterministic, keep_mask=postnet_keep)
         mel_postnet = mel_out + postnet_residual

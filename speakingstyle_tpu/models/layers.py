@@ -16,6 +16,7 @@ from typing import Optional, Tuple
 import flax.linen as nn
 import jax.numpy as jnp
 
+from speakingstyle_tpu.ops.dropout import Dropout
 from speakingstyle_tpu.ops.masking import attention_bias, mask_fill
 
 LN_EPS = 1e-5
@@ -55,6 +56,7 @@ class MultiHeadSelfAttention(nn.Module):
     softmax_dtype: jnp.dtype = jnp.float32
     attention_kernel: str = "einsum"  # "einsum" | "fused" (pallas)
     seq_mesh: Optional[object] = None  # jax.sharding.Mesh with a "seq" axis
+    dropout_impl: str = "bernoulli"
 
     @nn.compact
     def __call__(self, x, pad_mask, deterministic: bool):
@@ -108,7 +110,9 @@ class MultiHeadSelfAttention(nn.Module):
                 B, L, self.d_model
             )
         out = nn.Dense(self.d_model, dtype=self.dtype, name="fc")(out)
-        out = nn.Dropout(self.dropout)(out, deterministic=deterministic)
+        out = Dropout(self.dropout, impl=self.dropout_impl)(
+            out, deterministic=deterministic
+        )
         out = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype, name="layer_norm")(
             out + residual
         )
@@ -124,6 +128,7 @@ class ConvFFN(nn.Module):
     dropout: float
     conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
+    dropout_impl: str = "bernoulli"
 
     @nn.compact
     def __call__(self, x, deterministic: bool):
@@ -145,7 +150,9 @@ class ConvFFN(nn.Module):
             dtype=self.dtype,
             name="w_2",
         )(h)
-        h = nn.Dropout(self.dropout)(h, deterministic=deterministic)
+        h = Dropout(self.dropout, impl=self.dropout_impl)(
+            h, deterministic=deterministic
+        )
         return nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype, name="layer_norm")(
             h + residual
         )
@@ -170,6 +177,7 @@ class FFTBlock(nn.Module):
     softmax_dtype: jnp.dtype = jnp.float32
     attention_kernel: str = "einsum"
     seq_mesh: Optional[object] = None
+    dropout_impl: str = "bernoulli"
 
     @nn.compact
     def __call__(self, x, pad_mask, gammas=None, betas=None, deterministic=True):
@@ -177,7 +185,8 @@ class FFTBlock(nn.Module):
             self.n_head, self.d_model, self.dropout, dtype=self.dtype,
             softmax_dtype=self.softmax_dtype,
             attention_kernel=self.attention_kernel,
-            seq_mesh=self.seq_mesh, name="slf_attn"
+            seq_mesh=self.seq_mesh, dropout_impl=self.dropout_impl,
+            name="slf_attn"
         )(x, pad_mask, deterministic)
         x = mask_fill(x, pad_mask)
         x = ConvFFN(
@@ -187,6 +196,7 @@ class FFTBlock(nn.Module):
             self.dropout,
             conv_impl=self.conv_impl,
             dtype=self.dtype,
+            dropout_impl=self.dropout_impl,
             name="pos_ffn",
         )(x, deterministic)
         if self.film and gammas is not None and betas is not None:

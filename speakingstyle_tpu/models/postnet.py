@@ -11,6 +11,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from speakingstyle_tpu.ops.conv import Conv1d
+from speakingstyle_tpu.ops.dropout import Dropout
 
 
 class PostNet(nn.Module):
@@ -21,6 +22,7 @@ class PostNet(nn.Module):
     dropout: float = 0.5
     conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
+    dropout_impl: str = "bernoulli"
 
     @nn.compact
     def __call__(self, mel, deterministic=True, keep_mask=None):
@@ -56,7 +58,9 @@ class PostNet(nn.Module):
             )(x)
             if not is_last:
                 x = jnp.tanh(x)
-            x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
+            x = Dropout(self.dropout, impl=self.dropout_impl)(
+                x, deterministic=deterministic
+            )
             if keep_mask is not None:
                 x = jnp.where(keep_mask[..., None], x, 0.0)
         return x

@@ -17,6 +17,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from speakingstyle_tpu.models.layers import ConvNorm, FFTBlock, LinearNorm, LN_EPS
+from speakingstyle_tpu.ops.dropout import Dropout
 from speakingstyle_tpu.ops.masking import mask_fill
 from speakingstyle_tpu.ops.positional import add_position_encoding
 
@@ -35,6 +36,7 @@ class ReferenceEncoder(nn.Module):
     dtype: jnp.dtype = jnp.float32
     softmax_dtype: jnp.dtype = jnp.float32
     attention_kernel: str = "einsum"
+    dropout_impl: str = "bernoulli"
 
     @nn.compact
     def __call__(self, mel, pad_mask, deterministic=True):
@@ -88,7 +90,9 @@ class ReferenceEncoder(nn.Module):
                 x = nn.LayerNorm(
                     epsilon=LN_EPS, dtype=self.dtype, name=f"ln_{i}"
                 )(x)
-            x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
+            x = Dropout(self.dropout, impl=self.dropout_impl)(
+                x, deterministic=deterministic
+            )
         x = mask_fill(x, pad_mask)
 
         x = add_position_encoding(x, self.n_position)
@@ -106,6 +110,7 @@ class ReferenceEncoder(nn.Module):
                 dtype=self.dtype,
                 softmax_dtype=self.softmax_dtype,
                 attention_kernel=self.attention_kernel,
+                dropout_impl=self.dropout_impl,
                 name=f"fftb_{i}",
             )(x, pad_mask, deterministic=deterministic)
 

@@ -38,6 +38,7 @@ class FFTStack(nn.Module):
     softmax_dtype: jnp.dtype = jnp.float32
     attention_kernel: str = "einsum"
     seq_mesh: Optional[object] = None  # engages ring attention when set
+    dropout_impl: str = "bernoulli"
 
     @nn.compact
     def __call__(self, x, pad_mask, gammas=None, betas=None, deterministic=True):
@@ -60,6 +61,7 @@ class FFTStack(nn.Module):
                 softmax_dtype=self.softmax_dtype,
                 attention_kernel=self.attention_kernel,
                 seq_mesh=self.seq_mesh,
+                dropout_impl=self.dropout_impl,
                 name=f"layer_{i}",
             )(x, pad_mask, gammas, betas, deterministic)
         return x
@@ -82,6 +84,7 @@ class Encoder(nn.Module):
     softmax_dtype: jnp.dtype = jnp.float32
     attention_kernel: str = "einsum"
     seq_mesh: Optional[object] = None
+    dropout_impl: str = "bernoulli"
 
     @nn.compact
     def __call__(self, token_ids, pad_mask, gammas=None, betas=None, deterministic=True):
@@ -106,6 +109,7 @@ class Encoder(nn.Module):
             softmax_dtype=self.softmax_dtype,
             attention_kernel=self.attention_kernel,
             seq_mesh=self.seq_mesh,
+            dropout_impl=self.dropout_impl,
             name="layer_stack",
         )(x, pad_mask, gammas, betas, deterministic)
 
@@ -126,6 +130,7 @@ class Decoder(nn.Module):
     softmax_dtype: jnp.dtype = jnp.float32
     attention_kernel: str = "einsum"
     seq_mesh: Optional[object] = None
+    dropout_impl: str = "bernoulli"
 
     @nn.compact
     def __call__(self, x, pad_mask, gammas=None, betas=None, deterministic=True):
@@ -144,5 +149,6 @@ class Decoder(nn.Module):
             softmax_dtype=self.softmax_dtype,
             attention_kernel=self.attention_kernel,
             seq_mesh=self.seq_mesh,
+            dropout_impl=self.dropout_impl,
             name="layer_stack",
         )(x, pad_mask, gammas, betas, deterministic)

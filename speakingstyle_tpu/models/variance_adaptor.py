@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from speakingstyle_tpu.models.layers import FiLM, LN_EPS
+from speakingstyle_tpu.ops.dropout import Dropout
 from speakingstyle_tpu.ops.length_regulator import length_regulate, predicted_durations
 from speakingstyle_tpu.ops.quantize import bucketize
 
@@ -32,6 +33,7 @@ class VariancePredictor(nn.Module):
     dropout: float = 0.5
     conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
+    dropout_impl: str = "bernoulli"
 
     @nn.compact
     def __call__(self, x, pad_mask, gammas=None, betas=None, deterministic=True):
@@ -47,7 +49,9 @@ class VariancePredictor(nn.Module):
                 name=f"conv1d_{i}",
             )(x)
             x = nn.LayerNorm(epsilon=LN_EPS, dtype=self.dtype, name=f"layer_norm_{i}")(x)
-            x = nn.Dropout(self.dropout)(x, deterministic=deterministic)
+            x = Dropout(self.dropout, impl=self.dropout_impl)(
+                x, deterministic=deterministic
+            )
         if gammas is not None and betas is not None:
             x = FiLM(name="film")(x, gammas, betas)
         out = nn.Dense(1, dtype=self.dtype, name="linear_layer")(x)[..., 0]
@@ -74,6 +78,7 @@ class VarianceAdaptor(nn.Module):
     dropout: float = 0.5
     conv_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
+    dropout_impl: str = "bernoulli"
 
     def _bins(self, stats, quantization):
         from speakingstyle_tpu.ops.quantize import make_bins
@@ -99,7 +104,8 @@ class VarianceAdaptor(nn.Module):
     ):
         mk_pred = lambda name: VariancePredictor(
             self.filter_size, self.kernel_size, self.dropout,
-            conv_impl=self.conv_impl, dtype=self.dtype, name=name
+            conv_impl=self.conv_impl, dtype=self.dtype,
+            dropout_impl=self.dropout_impl, name=name
         )
         embed = lambda name: nn.Embed(self.n_bins, self.d_model, dtype=self.dtype, name=name)
 

@@ -103,6 +103,67 @@ def test_grad_reverse():
 
 
 # ---------------------------------------------------------------------------
+# dropout impls (ops/dropout.py)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("impl", ["bernoulli", "bits16", "hash"])
+def test_dropout_impls(impl):
+    """Every mask impl: correct keep rate, inverted scaling, determinism
+    per key, decorrelation across keys, and exact zeros at drops."""
+    import jax
+
+    from speakingstyle_tpu.ops.dropout import dropout, keep_mask
+
+    rate = 0.2
+    shape = (65, 97, 33)  # odd element count: exercises the bits16 tail slice
+    k1, k2 = jax.random.PRNGKey(3), jax.random.PRNGKey(4)
+    m1 = np.asarray(keep_mask(k1, rate, shape, impl))
+    m1b = np.asarray(keep_mask(k1, rate, shape, impl))
+    m2 = np.asarray(keep_mask(k2, rate, shape, impl))
+    assert m1.shape == shape and m1.dtype == bool
+    np.testing.assert_array_equal(m1, m1b)  # deterministic per key
+    assert m1.mean() == pytest.approx(1 - rate, abs=0.01)
+    assert (m1 != m2).mean() > 0.2  # different keys -> different masks
+
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(shape),
+                    jnp.float32)
+    y = np.asarray(dropout(x, rate, k1, impl=impl))
+    np.testing.assert_allclose(
+        y[m1], np.asarray(x)[m1] / (1 - rate), rtol=1e-6
+    )
+    assert (y[~m1] == 0).all()
+
+    # grad flows only through kept elements, scaled
+    g = jax.grad(lambda x_: jnp.sum(dropout(x_, rate, k1, impl=impl)))(x)
+    np.testing.assert_allclose(
+        np.asarray(g), m1.astype(np.float32) / (1 - rate), rtol=1e-6
+    )
+
+
+def test_dropout_hash_no_spatial_structure():
+    """The counter-hash mask must not correlate along any axis (the risk
+    of an iota-based stream): neighboring elements' keep decisions are
+    statistically independent."""
+    import jax
+
+    from speakingstyle_tpu.ops.dropout import keep_mask
+
+    m = np.asarray(
+        keep_mask(jax.random.PRNGKey(0), 0.5, (256, 256), "hash")
+    ).astype(np.int8)
+    # lag-1 agreement along each axis ~ 0.5 for independent bits
+    for ax in (0, 1):
+        a = np.take(m, range(0, m.shape[ax] - 1), axis=ax)
+        b = np.take(m, range(1, m.shape[ax]), axis=ax)
+        assert abs((a == b).mean() - 0.5) < 0.02
+    # and across keys
+    m2 = np.asarray(
+        keep_mask(jax.random.PRNGKey(1), 0.5, (256, 256), "hash")
+    ).astype(np.int8)
+    assert abs((m == m2).mean() - 0.5) < 0.02
+
+
+# ---------------------------------------------------------------------------
 # conv1d lowerings (ops/conv.py, ops/pallas_conv.py) — fast parity gate
 # ---------------------------------------------------------------------------
 
