@@ -68,7 +68,18 @@ WARMUP_STEPS, BENCH_STEPS = 3, 50
 # (-19%), fused_optimizer (-5%: ravel/unravel copies exceed the optax
 # chain overhead), in-kernel bf16 softmax (wash). The dict stays as the
 # mechanism for future A/Bs; the headline echoes it in the JSON line.
-TUNED_OVERRIDES = {"conv_impl": "xla", "attention_kernel": "fused"}
+TUNED_OVERRIDES = {
+    "conv_impl": "xla",
+    "attention_kernel": "fused",
+    # r5 additions, each measured on-chip (PERF.md): fused counter-hash
+    # dropout masks (+6.2%) and the per-leaf fused optimizer (+0.6%).
+    # dropout_impl=hash is also the ModelConfig default; fused_optimizer
+    # stays off in TrainConfig because its opt_state layout differs from
+    # the optax chain's (checkpoint compatibility), which a fresh bench
+    # run doesn't care about.
+    "dropout_impl": "hash",
+    "fused_optimizer": "leaf",
+}
 
 
 def _apply_overrides(cfg, overrides: dict):

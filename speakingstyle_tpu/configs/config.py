@@ -329,7 +329,13 @@ class TrainConfig:
     # copies cost more than the chain overhead they remove (422.6k vs
     # 442.8k frames/s — see PERF.md), so this stays off by default and is
     # kept as an honest A/B knob.
-    fused_optimizer: bool = False
+    # r5 adds "leaf" (training/optim.make_leaf_fused_optimizer): the whole
+    # clip+L2+Adam+lr chain as ONE fused expression per param leaf — no
+    # ravel copies, no per-stage intermediate trees. True == "flat" for
+    # back-compat. All three impls produce bit-identical updates (parity
+    # test); opt_state layouts differ, so optimizer checkpoints are not
+    # interchangeable across impls.
+    fused_optimizer: object = False  # False | True | "flat" | "leaf"
 
 
 @dataclass(frozen=True)

@@ -257,20 +257,18 @@ def test_training_descends_on_learnable_synthetic_corpus(tmp_path):
     assert late < 0.7 * early, (early, late, losses)
 
 
+@pytest.mark.parametrize("impl", ["flat", "leaf"])
 @pytest.mark.parametrize("weight_decay,grad_acc", [(0.0, 1), (0.01, 1), (0.0, 2)])
-def test_fused_optimizer_matches_chain(weight_decay, grad_acc):
-    """make_fused_optimizer (one pass over the raveled vector) produces the
-    same parameter trajectory as the optax chain — including the global-norm
-    clip engaging (step with large grads), bias correction, the LR
-    schedule's step indexing, the L2-before-moments weight decay, and the
-    MultiSteps grad-accumulation wrapper."""
+def test_fused_optimizer_matches_chain(weight_decay, grad_acc, impl):
+    """Both fused optimizers (flat raveled-vector and r5's per-leaf fused
+    chain) produce the same parameter trajectory as the optax chain —
+    including the global-norm clip engaging (step with large grads), bias
+    correction, the LR schedule's step indexing, the L2-before-moments
+    weight decay, and the MultiSteps grad-accumulation wrapper."""
     import optax
 
     from speakingstyle_tpu.configs.config import TrainConfig
-    from speakingstyle_tpu.training.optim import (
-        make_fused_optimizer,
-        make_optimizer,
-    )
+    from speakingstyle_tpu.training.optim import make_optimizer
 
     cfg = TrainConfig()
     cfg = dataclasses.replace(
@@ -285,7 +283,9 @@ def test_fused_optimizer_matches_chain(weight_decay, grad_acc):
         "b": jnp.asarray(rng.standard_normal(11), jnp.float32),
     }
     tx_chain = make_optimizer(cfg)
-    tx_fused = make_fused_optimizer(cfg)
+    tx_fused = make_optimizer(
+        dataclasses.replace(cfg, fused_optimizer=impl)
+    )
     s_chain = tx_chain.init(params)
     s_fused = tx_fused.init(params)
     p_chain = p_fused = params
