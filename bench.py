@@ -68,6 +68,9 @@ WARMUP_STEPS, BENCH_STEPS = 3, 50
 # (-19%), fused_optimizer (-5%: ravel/unravel copies exceed the optax
 # chain overhead), in-kernel bf16 softmax (wash). The dict stays as the
 # mechanism for future A/Bs; the headline echoes it in the JSON line.
+# (The fused_optimizer negative above refers to the r4 "flat" raveled
+# variant; the r5 "leaf" per-leaf variant measured +0.6% and IS adopted
+# below.)
 TUNED_OVERRIDES = {
     "conv_impl": "xla",
     "attention_kernel": "fused",

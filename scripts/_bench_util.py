@@ -1,0 +1,53 @@
+"""Shared preamble for the on-chip experiment scripts.
+
+One canonical copy of the three things every probe needs, so the timing
+discipline (PERF.md "Timing methodology") cannot drift between scripts:
+
+* repo-root sys.path bootstrap (PYTHONPATH at interpreter startup breaks
+  the tunneled-TPU "axon" jax plugin discovery, so extend sys.path here);
+* the persistent compilation cache config;
+* ``timeit``: explicit device->host scalar read as the sync point
+  (``block_until_ready`` can return before the tunnel's async dispatch
+  queue drains), 50 iterations. Callables passed to it must reduce their
+  result to a scalar (or small array) IN-GRAPH — returning a big array
+  puts its one-off D2H transfer inside the timed region.
+
+Import as ``from _bench_util import timeit, require_tpu`` (the scripts
+run with scripts/ as sys.path[0]).
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import jax  # noqa: E402  (after the sys.path bootstrap by design)
+
+jax.config.update(
+    "jax_compilation_cache_dir", os.path.join(REPO, ".jax_cache")
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+ITERS = 50
+
+
+def require_tpu():
+    from speakingstyle_tpu.ops.pallas_attention import _on_tpu
+
+    assert _on_tpu(), f"not a TPU: {jax.devices()[0]}"
+
+
+def timeit(fn, *args, iters: int = ITERS):
+    """ms per call of fn(*args), warm, D2H-scalar-synced."""
+    out = fn(*args)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(leaf.ravel()[0])  # D2H sync after compile+warm
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    float(leaf.ravel()[0])
+    return (time.perf_counter() - t0) / iters * 1e3

@@ -15,44 +15,19 @@ device->host scalar read as the sync point, 50 iterations.
 Usage: python scripts/exp_conv_r5.py [--fwd-only]
 """
 
-import os
 import sys
-import time
 
-# repo-root import bootstrap: PYTHONPATH at interpreter startup breaks the
-# tunneled-TPU ("axon") jax plugin discovery, so extend sys.path here instead
-sys.path.insert(
-    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
+from _bench_util import ITERS, require_tpu, timeit  # noqa: F401 (bootstraps sys.path/cache)
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 ".jax_cache"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 import speakingstyle_tpu.ops.pallas_conv as pc
 from speakingstyle_tpu.ops.pallas_conv import fused_conv1d, fused_conv_relu_ln
 
-ITERS = 50
 DT = jnp.bfloat16
-
-
-def timeit(fn, *args):
-    out = fn(*args)
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    float(leaf.ravel()[0])  # D2H sync after compile+warm
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        out = fn(*args)
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    float(leaf.ravel()[0])
-    return (time.perf_counter() - t0) / ITERS * 1e3
 
 
 def xla_fused(x, w, b, s, sb, relu, ln):
@@ -70,17 +45,15 @@ def xla_fused(x, w, b, s, sb, relu, ln):
     return y
 
 
-def pallas_fused(x, w, b, s, sb, relu, ln):
+def pallas_fused(x, w, b, s, sb, relu, ln, bwd_mode="analytic"):
     if ln:
-        return fused_conv_relu_ln(x, w, b, s, sb)
-    return fused_conv1d(x, w, b, relu=relu)
+        return fused_conv_relu_ln(x, w, b, s, sb, bwd_mode=bwd_mode)
+    return fused_conv1d(x, w, b, relu=relu, bwd_mode=bwd_mode)
 
 
 def main():
     fwd_only = "--fwd-only" in sys.argv
-    from speakingstyle_tpu.ops.pallas_attention import _on_tpu
-
-    assert _on_tpu(), f"not a TPU: {jax.devices()[0]}"
+    require_tpu()
 
     rng = np.random.default_rng(0)
     # (name, B, T, cin, cout, K, relu, ln)
@@ -102,21 +75,26 @@ def main():
         res = {}
         for label, fn in (("xla", xla_fused), ("pallas", pallas_fused)):
 
-            def loss(x_, w_, b_, s_, sb_, fn=fn):
+            def loss(x_, w_, b_, s_, sb_, fn=fn, mode="analytic"):
+                kw = {} if fn is xla_fused else {"bwd_mode": mode}
                 return jnp.sum(
-                    fn(x_, w_, b_, s_, sb_, relu, ln).astype(jnp.float32) ** 2
+                    fn(x_, w_, b_, s_, sb_, relu, ln, **kw).astype(
+                        jnp.float32) ** 2
                 )
 
             if fwd_only:
                 res[label] = timeit(jax.jit(loss), x, w, b, s, sb)
             elif label == "pallas":
+                # bwd_mode is an explicit argument (not the module global):
+                # it is baked into each freshly-traced grad function
+                import functools
                 for mode in ("analytic", "recompute"):
-                    pc.BWD_MODE = mode
                     res[f"pallas-{mode}"] = timeit(
-                        jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3, 4))),
+                        jax.jit(jax.grad(
+                            functools.partial(loss, mode=mode),
+                            argnums=(0, 1, 2, 3, 4))),
                         x, w, b, s, sb,
                     )
-                pc.BWD_MODE = "analytic"
             else:
                 res[label] = timeit(
                     jax.jit(jax.grad(loss, argnums=(0, 1, 2, 3, 4))),

@@ -8,48 +8,23 @@ elementwise producer so the mask apply has something to fuse into.
 Usage: python scripts/exp_dropout_r5.py
 """
 
-import os
 import sys
-import time
 
-sys.path.insert(
-    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-)
+from _bench_util import ITERS, require_tpu, timeit  # noqa: F401 (bootstraps sys.path/cache)
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                 ".jax_cache"),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 jax.config.update("jax_default_prng_impl", "rbg")
 
 from speakingstyle_tpu.ops.dropout import DROPOUT_IMPLS, dropout
 
-ITERS = 50
 DT = jnp.bfloat16
 
 
-def timeit(fn, *args):
-    out = fn(*args)
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    float(leaf.ravel()[0])
-    t0 = time.perf_counter()
-    for _ in range(ITERS):
-        out = fn(*args)
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    float(leaf.ravel()[0])
-    return (time.perf_counter() - t0) / ITERS * 1e3
-
-
 def main():
-    from speakingstyle_tpu.ops.pallas_attention import _on_tpu
-
-    assert _on_tpu(), f"not a TPU: {jax.devices()[0]}"
+    require_tpu()
     rng = np.random.default_rng(0)
     key = jax.random.PRNGKey(0)
     # N dependency-chained sites inside ONE jit: amplifies the per-site

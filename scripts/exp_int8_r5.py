@@ -1,0 +1,124 @@
+"""Round-5 int8 probe: is quantized conv compute the route past the
+~2.1x bf16 ceiling (PERF.md)?
+
+Three questions, each answered on-chip at the model's heavy conv
+geometries (as unfold GEMMs, [B*T, K*Cin] @ [K*Cin, Cout]):
+
+1. raw MXU rate: s8 x s8 -> s32 dot vs bf16 x bf16 -> f32 dot on
+   pre-quantized operands (the hardware's 2x int8 claim, isolated);
+2. fake-quant conv fwd: bf16 in/out with dynamic per-tensor activation
+   quant + per-channel weight quant + dequant epilogue, vs the XLA bf16
+   conv emitter (what a real int8 training fwd pass would cost);
+3. int8 conv fwd+bwd with a straight-through estimator (bf16 backward
+   via the analytic conv vjp), vs bf16 conv fwd+bwd.
+
+Usage: python scripts/exp_int8_r5.py
+"""
+
+import sys
+
+from _bench_util import ITERS, require_tpu, timeit  # noqa: F401 (bootstraps sys.path/cache)
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main():
+    require_tpu()
+    rng = np.random.default_rng(0)
+
+    # --- 1. raw GEMM rates ---
+    print("== raw GEMM: s8xs8->s32 vs bf16xbf16->f32 ==", flush=True)
+    for (m, k, n) in ((28800, 3072, 1024), (28800, 2304, 1024),
+                      (28800, 2560, 512)):
+        a8 = jnp.asarray(rng.integers(-127, 128, (m, k)), jnp.int8)
+        b8 = jnp.asarray(rng.integers(-127, 128, (k, n)), jnp.int8)
+        ab = jnp.asarray(rng.standard_normal((m, k)), jnp.bfloat16)
+        bb = jnp.asarray(rng.standard_normal((k, n)), jnp.bfloat16)
+
+        # reduce to a scalar IN-GRAPH: returning the [m, n] product would
+        # put a one-off 100+ MB D2H transfer inside the timed sync
+        f_i8 = jax.jit(lambda x, y: jnp.sum(jax.lax.dot(
+            x, y, preferred_element_type=jnp.int32)))
+        f_bf = jax.jit(lambda x, y: jnp.sum(jax.lax.dot(
+            x, y, preferred_element_type=jnp.float32).astype(jnp.float32)))
+        t_i8 = timeit(f_i8, a8, b8)
+        t_bf = timeit(f_bf, ab, bb)
+        tf = 2 * m * k * n / 1e12
+        print(f"[{m},{k}]@[{k},{n}]: int8 {t_i8:6.3f}ms ({tf/t_i8*1e3:6.1f} "
+              f"TOP/s)  bf16 {t_bf:6.3f}ms ({tf/t_bf*1e3:6.1f} TF/s)  "
+              f"ratio {t_bf/t_i8:.2f}x", flush=True)
+
+    # --- 2+3. fake-quant unfold conv vs bf16 conv emitter ---
+    print("== conv fwd / fwd+bwd: int8 fake-quant unfold vs xla bf16 ==",
+          flush=True)
+
+    def conv_bf16(x, w):
+        return jax.lax.conv_general_dilated(
+            x, w, (1,), "SAME", dimension_numbers=("NWC", "WIO", "NWC"))
+
+    def conv_int8_fwd(x, w):
+        """dynamic per-tensor act quant, per-Cout weight quant, int8 GEMM."""
+        K, cin, cout = w.shape
+        xs = jnp.max(jnp.abs(x)).astype(jnp.float32) / 127.0
+        xq = jnp.clip(
+            jnp.round(x.astype(jnp.float32) / xs), -127, 127
+        ).astype(jnp.int8)
+        ws = jnp.max(jnp.abs(w), axis=(0, 1)).astype(jnp.float32) / 127.0
+        wq = jnp.clip(
+            jnp.round(w.astype(jnp.float32) / ws), -127, 127
+        ).astype(jnp.int8)
+        pad = (K - 1) // 2
+        xp = jnp.pad(xq, ((0, 0), (pad, K - 1 - pad), (0, 0)))
+        T = x.shape[1]
+        cols = jnp.stack(
+            [jax.lax.dynamic_slice_in_dim(xp, j, T, axis=1)
+             for j in range(K)], axis=2)  # [B,T,K,Cin] int8
+        acc = jax.lax.dot_general(
+            cols.reshape(-1, K * cin), wq.reshape(K * cin, cout),
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (xs * ws)
+        return y.reshape(x.shape[0], T, cout).astype(x.dtype)
+
+    @jax.custom_vjp
+    def conv_int8_ste(x, w):
+        return conv_int8_fwd(x, w)
+
+    def _fwd(x, w):
+        return conv_int8_ste(x, w), (x, w)
+
+    def _bwd(res, g):
+        x, w = res
+        _, vjp = jax.vjp(lambda x_, w_: conv_bf16(x_, w_), x, w)
+        return vjp(g)
+
+    conv_int8_ste.defvjp(_fwd, _bwd)
+
+    for name, (B, T, cin, cout, K) in (
+        ("refenc_c12 1024->1024 k3", (48, 600, 1024, 1024, 3)),
+        ("dec_w1 256->1024 k9", (48, 600, 256, 1024, 9)),
+        ("postnet 512->512 k5", (48, 600, 512, 512, 5)),
+    ):
+        x = jnp.asarray(rng.standard_normal((B, T, cin)), jnp.bfloat16)
+        w = jnp.asarray(rng.standard_normal((K, cin, cout)) * 0.05,
+                        jnp.bfloat16)
+        t_bf = timeit(jax.jit(
+            lambda x_, w_: jnp.sum(conv_bf16(x_, w_).astype(jnp.float32))),
+            x, w)
+        t_i8 = timeit(jax.jit(
+            lambda x_, w_: jnp.sum(conv_int8_fwd(x_, w_).astype(jnp.float32))),
+            x, w)
+        g_bf = timeit(jax.jit(jax.grad(
+            lambda x_, w_: jnp.sum(conv_bf16(x_, w_).astype(jnp.float32)),
+            argnums=(0, 1))), x, w)
+        g_i8 = timeit(jax.jit(jax.grad(
+            lambda x_, w_: jnp.sum(conv_int8_ste(x_, w_).astype(jnp.float32)),
+            argnums=(0, 1))), x, w)
+        print(f"{name:28s} fwd: bf16 {t_bf:6.3f}  int8 {t_i8:6.3f}  |  "
+              f"fwd+bwd(STE): bf16 {g_bf:6.3f}  int8 {g_i8:6.3f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -337,6 +337,13 @@ class TrainConfig:
     # interchangeable across impls.
     fused_optimizer: object = False  # False | True | "flat" | "leaf"
 
+    def __post_init__(self):
+        if self.fused_optimizer not in (False, True, "flat", "leaf"):
+            raise ValueError(
+                "fused_optimizer must be False|True|'flat'|'leaf', "
+                f"got {self.fused_optimizer!r}"
+            )
+
 
 @dataclass(frozen=True)
 class Config:

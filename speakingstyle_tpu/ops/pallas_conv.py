@@ -35,8 +35,9 @@ the conv emitter):
 
 Gradient parity vs the composed reference:
 tests/test_ops.py::test_conv1d_impl_parity,
-::test_fused_conv_relu_ln_matches_composed. Set ``BWD_MODE="recompute"``
-(module global) to A/B the old recompute path.
+::test_fused_conv_relu_ln_matches_composed. Pass ``bwd_mode="recompute"``
+to the public functions (or set the ``BWD_MODE`` module-global default
+before tracing) to A/B the old recompute path.
 
 Set ``interpret=True`` (or run on a non-TPU backend, which forces it) to
 emulate the kernel — CPU tests use this.
@@ -242,10 +243,10 @@ def _use_reference(ln_scale, kernel) -> bool:
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8)
+    jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9)
 )
 def _fused(x, kernel, bias, ln_scale, ln_bias, dilation, relu, tile,
-           interpret):
+           interpret, bwd_mode):
     if _use_reference(ln_scale, kernel):
         return _reference_fused(
             x, kernel, bias, ln_scale, ln_bias, dilation, relu
@@ -258,12 +259,16 @@ def _fused(x, kernel, bias, ln_scale, ln_bias, dilation, relu, tile,
 # "analytic" (default): epilogue backward from the saved post-ReLU
 # residual + linear-conv vjp for dx/dw. "recompute": the pre-r5 behavior
 # (full forward recompute through the im2col reference) — kept for A/B.
+# The module global is only the DEFAULT, resolved when the public
+# functions are called (i.e. at trace time); pass ``bwd_mode=`` explicitly
+# when A/B-ing so the mode is part of the traced function — flipping the
+# global after a callable is jitted does NOT retrace it.
 BWD_MODE = "analytic"
 
 
 def _fused_fwd(x, kernel, bias, ln_scale, ln_bias, dilation, relu, tile,
-               interpret):
-    if BWD_MODE != "analytic":
+               interpret, bwd_mode):
+    if bwd_mode != "analytic":
         y = _fused(x, kernel, bias, ln_scale, ln_bias, dilation, relu,
                    tile, interpret)
         return y, (x, kernel, bias, ln_scale, ln_bias, None)
@@ -287,9 +292,9 @@ def _fused_fwd(x, kernel, bias, ln_scale, ln_bias, dilation, relu, tile,
     return y, (x, kernel, bias, ln_scale, ln_bias, act)
 
 
-def _fused_bwd(dilation, relu, tile, interpret, res, g):
+def _fused_bwd(dilation, relu, tile, interpret, bwd_mode, res, g):
     x, kernel, bias, ln_scale, ln_bias, act = res
-    if BWD_MODE != "analytic":
+    if bwd_mode != "analytic":
         wrt = (x, kernel, bias, ln_scale, ln_bias)
 
         def f(x_, k_, b_, s_, sb_):
@@ -352,6 +357,7 @@ def fused_conv1d(
     relu: bool = False,
     tile: int = 256,
     interpret: Optional[bool] = None,
+    bwd_mode: Optional[str] = None,
 ):
     """SAME conv1d (+optional ReLU) via the fused kernel.
 
@@ -360,7 +366,7 @@ def fused_conv1d(
     interpret = _use_interpret() if interpret is None else interpret
     tile = _pick_tile(tile, x.shape[1])
     return _fused(x, kernel, bias, None, None, dilation, relu, tile,
-                  interpret)
+                  interpret, bwd_mode or BWD_MODE)
 
 
 def fused_conv_relu_ln(
@@ -373,10 +379,11 @@ def fused_conv_relu_ln(
     dilation: int = 1,
     tile: int = 256,
     interpret: Optional[bool] = None,
+    bwd_mode: Optional[str] = None,
 ):
     """conv1d -> ReLU -> LayerNorm in one pass (the reference-encoder conv
     stack pattern, reference: model/modules.py:361-379). Differentiable."""
     interpret = _use_interpret() if interpret is None else interpret
     tile = _pick_tile(tile, x.shape[1])
     return _fused(x, kernel, bias, ln_scale, ln_bias, dilation, True, tile,
-                  interpret)
+                  interpret, bwd_mode or BWD_MODE)
