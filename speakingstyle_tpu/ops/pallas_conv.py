@@ -270,7 +270,7 @@ def _fused_fwd(x, kernel, bias, ln_scale, ln_bias, dilation, relu, tile,
                interpret, bwd_mode):
     if bwd_mode != "analytic":
         y = _fused(x, kernel, bias, ln_scale, ln_bias, dilation, relu,
-                   tile, interpret)
+                   tile, interpret, bwd_mode)
         return y, (x, kernel, bias, ln_scale, ln_bias, None)
     if _use_reference(ln_scale, kernel):
         y, act = _reference_fused_parts(

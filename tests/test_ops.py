@@ -246,6 +246,31 @@ def test_fused_conv_relu_ln_matches_composed():
         np.testing.assert_allclose(np.asarray(gg), np.asarray(gw), atol=1e-4)
 
 
+def test_fused_conv_bwd_modes_agree():
+    """Both backward modes (analytic default, recompute A/B path) produce
+    the same gradients through the explicit ``bwd_mode`` argument."""
+    import jax
+
+    from speakingstyle_tpu.ops.pallas_conv import fused_conv1d
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((2, 16, 8)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((3, 8, 12)) * 0.1, jnp.float32)
+    grads = [
+        np.asarray(
+            jax.grad(
+                lambda x_: jnp.sum(
+                    fused_conv1d(
+                        x_, w, None, interpret=True, bwd_mode=m
+                    ) ** 2
+                )
+            )(x)
+        )
+        for m in ("analytic", "recompute")
+    ]
+    np.testing.assert_allclose(grads[0], grads[1], atol=1e-5)
+
+
 def test_fused_conv_relu_ln_grads_lane_aligned():
     """Gradient parity at a lane-aligned (cout=128) width: this is the
     config where the REAL kernel path runs (the cout=16 test above trips
