@@ -415,6 +415,62 @@ def run_infer():
         "samples_per_sec": round(Bv * T_MEL * hop / dt, 1),
     }))
 
+    # --- batch-1 warm end-to-end latency: text -> wav on the host ---
+    # The deployment metric the throughput rows don't show (reference:
+    # synthesize.py:128-150 single mode): host G2P + free-running acoustic
+    # model + HiFi-GAN + the wav's device->host read, per utterance.
+    from speakingstyle_tpu.text.g2p import preprocess_text
+
+    text = ("The quick brown fox jumps over the lazy dog and then runs "
+            "far away into the quiet green hills beyond the river")
+    T_lat = 640  # static mel buffer ~7.4 s of 22050 Hz audio at hop 256
+    fwd1 = jax.jit(
+        lambda v, b: model.apply(v, deterministic=True, **b,
+                                 max_mel_len=T_lat,
+                                 mutable=["batch_stats"])[0]["mel_postnet"]
+    )
+    pp_cfg = cfg.preprocess.preprocessing
+
+    def text_to_wav():
+        seq = preprocess_text(
+            text, pp_cfg.text.language, None, list(pp_cfg.text.text_cleaners)
+        )
+        L = max(16, -(-len(seq) // 16) * 16)
+        texts = np.zeros((1, L), np.int32)
+        texts[0, : len(seq)] = seq
+        b = {
+            "speakers": jnp.zeros((1,), jnp.int32),
+            "texts": jnp.asarray(texts),
+            "src_lens": jnp.asarray([len(seq)], jnp.int32),
+            # reference mel for the style encoder (single mode requires
+            # --ref_audio; a fixed mel stands in — same compute)
+            "mels": ref_mel,
+            "mel_lens": jnp.asarray([T_lat], jnp.int32),
+        }
+        mel = fwd1(variables, b)
+        wav = voc(params, mel)  # the batch-8 jit respecializes for batch 1
+        return np.asarray(wav)  # device->host: part of the user's latency
+
+    ref_mel = jnp.asarray(rng.standard_normal((1, T_lat, n_mels)), jnp.float32)
+    text_to_wav()  # compile + warm
+    lat = []
+    for _ in range(30):
+        t0 = time.perf_counter()
+        text_to_wav()
+        lat.append((time.perf_counter() - t0) * 1e3)
+    lat.sort()
+    p50 = lat[len(lat) // 2]
+    p95 = lat[int(len(lat) * 0.95)]
+    audio_s = T_lat * hop / sr
+    print(json.dumps({
+        "metric": "synthesis_batch1_latency_ms",
+        "value": round(p50, 1),
+        "unit": f"ms p50 warm text->wav ({audio_s:.1f}s utterance, incl. "
+                "G2P + D2H wav read)",
+        "p95_ms": round(p95, 1),
+        "realtime_factor": round(audio_s * 1e3 / p50, 1),
+    }))
+
 
 def run_ab():
     """A/B the performance knobs (README "Performance knobs"): one process

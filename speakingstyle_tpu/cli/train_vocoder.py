@@ -25,6 +25,10 @@ def build_parser(parser=None):
         "--warm_start", type=str, default=None,
         help="generator checkpoint (.pth.tar or .msgpack) to fine-tune from",
     )
+    parser.add_argument(
+        "--restore", type=str, default=None,
+        help="full-state vocoder checkpoint (.msgpack) to resume from",
+    )
     parser.add_argument("--data_parallel", type=int, default=None)
     return parser
 
@@ -59,6 +63,7 @@ def main(args):
         ckpt_path=args.checkpoint_path,
         fine_tune_mel_dir=args.fine_tune_mel_dir,
         gen_params=gen_params,
+        restore_path=args.restore,
     )
 
 

@@ -281,9 +281,16 @@ def _on_tpu() -> bool:
     return "tpu" in dev.platform.lower() or "tpu" in kind
 
 
-def supported(T: int, D: int) -> bool:
-    """Shapes this kernel handles; callers fall back to einsum otherwise."""
-    return D % 8 == 0 and D <= LANE and -(-T // LANE) * LANE <= MAX_T
+def supported(T: int, D: int, dtype=jnp.float32) -> bool:
+    """Shapes this kernel handles; callers fall back to einsum otherwise.
+
+    D rides on sublanes in the kernel's [B, H, D, T] layout, so it must be
+    a multiple of the dtype's sublane tiling: 8 for 4-byte dtypes, 16 for
+    bf16/f16, 32 for 1-byte dtypes (Mosaic packs 4/itemsize rows per
+    sublane — a D of 8/24/40 in bf16 would pass an %8 gate yet fail
+    lowering on real hardware)."""
+    sublane = max(8, 32 // jnp.dtype(dtype).itemsize)
+    return D % sublane == 0 and D <= LANE and -(-T // LANE) * LANE <= MAX_T
 
 
 def fused_mha(
@@ -310,7 +317,7 @@ def fused_mha(
     if interpret is None and FORCE_INTERPRET:
         interpret = True
     use_kernel = _on_tpu() if interpret is None else True
-    if not use_kernel or not supported(L, D):
+    if not use_kernel or not supported(L, D, q.dtype):
         return _reference_mha(q, k, v, pad_mask, sm_scale, softmax_dtype)
 
     Tp = -(-L // LANE) * LANE

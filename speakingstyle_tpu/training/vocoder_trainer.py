@@ -243,8 +243,43 @@ def save_vocoder(path: str, state: VocoderState):
 
 
 def restore_vocoder(path: str, state: VocoderState) -> VocoderState:
+    """Restore a full GAN state checkpoint into ``state``'s structure.
+
+    Tolerant of structure drift: checkpoints saved before the r4
+    spectral-norm addition lack ``msd_stats`` (and their first MSD scale's
+    param subtree differs). Any top-level field whose saved structure no
+    longer matches is kept at its freshly-initialized value, with a
+    warning — everything that does match is restored."""
     with open(path, "rb") as f:
-        return serialization.from_bytes(state, f.read())
+        data = f.read()
+    try:
+        return serialization.from_bytes(state, data)
+    except (ValueError, KeyError):
+        raw = serialization.msgpack_restore(data)
+        # ONLY the fields the r4 spectral-norm change touched may fall back
+        # to fresh values; a generator/optimizer/step mismatch means the
+        # checkpoint is from an incompatible run and must be a hard error
+        # (silently training fresh weights under a restored step counter
+        # would masquerade as a resume).
+        tolerated = {"msd_stats", "msd_params", "disc_opt"}
+        restored, kept_fresh = {}, []
+        for name in state._fields:
+            fresh = getattr(state, name)
+            try:
+                restored[name] = serialization.from_state_dict(
+                    fresh, raw[name]
+                )
+            except (ValueError, KeyError):
+                if name not in tolerated:
+                    raise
+                restored[name] = fresh
+                kept_fresh.append(name)
+        print(
+            f"[restore_vocoder] checkpoint {path} predates the current "
+            f"state layout; kept freshly-initialized: {kept_fresh} "
+            "(pre-r4 checkpoints lack the MSD spectral-norm state)"
+        )
+        return VocoderState(**restored)
 
 
 def train_vocoder(
@@ -260,13 +295,21 @@ def train_vocoder(
     fine_tune_mel_dir: Optional[str] = None,
     gen_params: Optional[Dict] = None,
     seed: int = 1234,
+    restore_path: Optional[str] = None,
 ):
-    """The full vocoder GAN loop (reference: hifigan/train.py:24-267)."""
+    """The full vocoder GAN loop (reference: hifigan/train.py:24-267).
+
+    ``restore_path`` resumes a previous run from a full-state checkpoint
+    (save_vocoder's .msgpack); the loop continues from the restored
+    ``state.step`` up to ``max_steps`` total."""
     from speakingstyle_tpu.data.mel_dataset import MelWavDataset
 
     state, gen, mpd, msd, gen_tx, disc_tx = init_vocoder_state(
         cfg, hp, jax.random.PRNGKey(seed), gen_params=gen_params
     )
+    if restore_path:
+        state = restore_vocoder(restore_path, state)
+        print(f"[vocoder] restored step {int(state.step)} from {restore_path}")
     if mesh is not None:
         state = jax.device_put(state, NamedSharding(mesh, P()))
     train_step = make_vocoder_train_step(
@@ -276,7 +319,8 @@ def train_vocoder(
         wav_paths, cfg, segment_size=hp.segment_size, batch_size=batch_size,
         fine_tune_mel_dir=fine_tune_mel_dir, seed=seed,
     )
-    step = 0
+    step = int(state.step)
+    metrics = {}
     for wavs, mels in ds:
         if step >= max_steps:
             break

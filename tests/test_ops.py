@@ -388,6 +388,11 @@ def test_fused_mha_unsupported_shapes_fall_back():
     assert not supported(600, 256)     # D > lane width
     assert not supported(2000, 64)     # T too long for VMEM scores
     assert supported(600, 32) and supported(1000, 128)
+    # sub-4-byte dtypes pack 2 rows/sublane: D must be a multiple of 16
+    assert not supported(600, 24, jnp.bfloat16)
+    assert not supported(600, 8, jnp.bfloat16)
+    assert supported(600, 32, jnp.bfloat16)
+    assert supported(600, 24)  # ...but f32 allows %8
 
     rng = np.random.default_rng(0)
     q = jnp.asarray(rng.standard_normal((2, 9, 2, 20)), jnp.float32)

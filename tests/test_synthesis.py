@@ -340,9 +340,12 @@ def test_trainer_default_synth_callback(tmp_path, synthetic_preprocessed):
 
 
 @pytest.mark.slow
-def test_cli_analyze_all_modes(tmp_path):
+def test_cli_analyze_all_modes(tmp_path, capsys):
     """`analyze` productizes the reference's variance-distribution and
-    ref-encoder notebooks: features, predictions (free-running), style."""
+    ref-encoder notebooks: features, predictions (free-running), style.
+    The two model-dependent modes analyze a REAL (briefly trained)
+    checkpoint, not a random init: a 2-step train leg saves a ckpt that
+    analyze restores (VERDICT r4 #8)."""
     import json as _json
 
     import yaml
@@ -366,7 +369,10 @@ def test_cli_analyze_all_modes(tmp_path):
                   "max_seq_len": 96},
         "train": {"path": {"ckpt_path": str(tmp_path / "ckpt"),
                            "log_path": str(tmp_path / "log"),
-                           "result_path": str(tmp_path / "res")}},
+                           "result_path": str(tmp_path / "res")},
+                  "optimizer": {"batch_size": 4},
+                  "step": {"total_step": 2, "save_step": 2, "log_step": 1,
+                           "val_step": 100, "synth_step": 10**9}},
     }
     cargs = []
     for name, doc in docs.items():
@@ -378,9 +384,16 @@ def test_cli_analyze_all_modes(tmp_path):
     feats = main(["analyze", *cargs, "--what", "features"])
     assert feats["pitch"]["count"] > 0 and feats["duration"]["count"] > 0
 
+    # a real checkpoint for the model-dependent modes
+    main(["train", *cargs, "--max_steps", "2", "--data_parallel", "1"])
+    capsys.readouterr()
+
     preds = main(["analyze", *cargs, "--what", "predictions",
                   "--max_batches", "2"])
+    assert "restored checkpoint @ step 2" in capsys.readouterr().out
     assert preds["pitch"]["pred"]["count"] > 0
+    # non-degenerate true-vs-predicted histogram overlap from real weights
+    assert 0.0 < preds["pitch"]["hist_overlap"] <= 1.0
 
     out_json = str(tmp_path / "style.json")
     style = main(["analyze", *cargs, "--what", "style", "--max_batches", "2",
