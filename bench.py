@@ -87,10 +87,18 @@ TUNED_OVERRIDES = {
 
 def _apply_overrides(cfg, overrides: dict):
     """Route each override key to the dataclass that owns it (ModelConfig
-    or TrainConfig)."""
+    or TrainConfig); unknown keys are a clear error instead of a confusing
+    dataclasses.replace TypeError."""
     import dataclasses
 
     model_keys = {f.name for f in dataclasses.fields(cfg.model)}
+    train_keys = {f.name for f in dataclasses.fields(cfg.train)}
+    unknown = set(overrides) - model_keys - train_keys
+    if unknown:
+        raise ValueError(
+            f"unknown override key(s) {sorted(unknown)}: not a field of "
+            "ModelConfig or TrainConfig"
+        )
     m = {k: v for k, v in overrides.items() if k in model_keys}
     t = {k: v for k, v in overrides.items() if k not in model_keys}
     if m:
