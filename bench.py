@@ -219,25 +219,33 @@ def main(report_flops: bool = False, profile: bool = False,
     batch = jax.device_put(batch)
     rng = jax.random.PRNGKey(1)
 
+    # XLA compiler-option experiments (XLA_FLAGS is rejected by the
+    # tunneled backend's host-side flag parser; per-compile options work):
+    # BENCH_COMPILER_OPTIONS='{"xla_tpu_scoped_vmem_limit_kib": "65536"}'
+    copts = json.loads(os.environ.get("BENCH_COMPILER_OPTIONS", "null"))
+
     if report_flops:
-        compiled = train_step.lower(state, batch, rng).compile()
+        compiled = train_step.lower(state, batch, rng).compile(
+            compiler_options=copts
+        )
         cost = compiled.cost_analysis()
         cost = cost[0] if isinstance(cost, (list, tuple)) else cost
         flops = float(cost.get("flops", float("nan")))
-        print(
-            json.dumps(
-                {
-                    "metric": "train_step_flops",
-                    "value": flops,
-                    "unit": "FLOP/step",
-                    "per_frame_mflop": round(flops / (B * T_MEL) / 1e6, 1),
-                }
-            )
-        )
+        out = {
+            "metric": "train_step_flops",
+            "value": flops,
+            "unit": "FLOP/step",
+            "per_frame_mflop": round(flops / (B * T_MEL) / 1e6, 1),
+        }
+        if copts:
+            out["compiler_options"] = copts
+        print(json.dumps(out))
         return
 
     _mark("compile start (train_step.lower().compile())")
-    compiled = train_step.lower(state, batch, rng).compile()
+    compiled = train_step.lower(state, batch, rng).compile(
+        compiler_options=copts
+    )
     _mark("compile end")
 
     for _ in range(WARMUP_STEPS):
@@ -272,6 +280,10 @@ def main(report_flops: bool = False, profile: bool = False,
     }
     if overrides:
         out["overrides"] = overrides
+    if copts:
+        # experiment compiler options change the measurement — they must
+        # be attributable in the recorded line, like overrides
+        out["compiler_options"] = copts
     print(json.dumps(out))
 
 

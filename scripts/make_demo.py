@@ -1,0 +1,158 @@
+"""Build a static listening page (the reference's index.html + demo/
+counterpart, reference: index.html, demo/LJSpeech/*) from a trained
+checkpoint.
+
+For each utterance of a metadata split this synthesizes ground-truth-vs-
+synthesized pairs with the real pipeline (teacher-forced mel for GT
+timing, free-running for synthesis, HiFi-GAN or Griffin-Lim vocoding) and
+writes ``demo/<dataset>/*.wav`` plus a self-contained ``index.html`` with
+paired players — the page the reference ships pre-built.
+
+    python scripts/make_demo.py -p preprocess.yaml -m model.yaml \
+        -t train.yaml --restore_step -1 --n_utts 8 --out demo_out \
+        [--griffin_lim]
+
+Needs a real checkpoint to sound like anything; in this environment
+(zero-egress: the published 900k-step weights cannot be fetched) it is the
+MACHINERY counterpart — run it against your own training run.
+"""
+
+import argparse
+import html
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+PAGE = """<!DOCTYPE html>
+<html><head><meta charset="utf-8"/>
+<meta name="viewport" content="width=device-width,initial-scale=1"/>
+<title>speakingstyle_tpu audio samples</title>
+<style>
+body {{ margin: 0 15%; padding: 40px 20px; font-family: sans-serif;
+       line-height: 1.7; color: #111; }}
+h1 {{ font-size: 1.6em; }} h2 {{ margin-bottom: 0.3em; }}
+table {{ width: 100%; border-collapse: collapse; }}
+td, th {{ padding: 6px 8px; text-align: center; }}
+tr {{ border-bottom: 0.5px solid lightgray; }}
+audio {{ width: 100%; }}
+.text {{ text-align: left; font-size: 0.92em; color: #333; }}
+</style></head><body>
+<h1>speakingstyle_tpu — audio samples</h1>
+<p>Ground truth vs. synthesized (free-running, style from the ground-truth
+reference) for {n} utterances of <b>{dataset}</b>, checkpoint step
+{step}.</p>
+<table>
+<tr><th style="width:40%">Text</th><th>Ground truth</th><th>Synthesized</th></tr>
+{rows}
+</table></body></html>
+"""
+
+ROW = """<tr><td class="text">{text}</td>
+<td><audio controls preload="none" src="{gt}"></audio></td>
+<td><audio controls preload="none" src="{syn}"></audio></td></tr>
+"""
+
+
+def main():
+    from speakingstyle_tpu.cli import add_config_args, config_from_args
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    add_config_args(ap, required=True)
+    ap.add_argument("--restore_step", type=int, default=-1)
+    ap.add_argument("--split", default="val.txt")
+    ap.add_argument("--n_utts", type=int, default=8)
+    ap.add_argument("--out", default="demo_out")
+    ap.add_argument("--griffin_lim", action="store_true",
+                    help="vocoder-free output (no vocoder checkpoint needed)")
+    ap.add_argument("--vocoder_ckpt", default=None)
+    ap.add_argument("--vocoder_config", default=None,
+                    help="hifigan config.json for a non-default "
+                    "generator topology (forwarded to get_vocoder)")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from speakingstyle_tpu.audio.tools import save_wav
+    from speakingstyle_tpu.cli.analyze import _restored_state
+    from speakingstyle_tpu.data import BucketedBatcher, SpeechDataset
+    from speakingstyle_tpu.models.factory import build_model
+    from speakingstyle_tpu.synthesis import _vocode, get_vocoder
+
+    cfg = config_from_args(args)
+    dataset = cfg.preprocess.dataset
+    pp = cfg.preprocess.preprocessing
+    out_dir = os.path.join(args.out, dataset)
+    os.makedirs(out_dir, exist_ok=True)
+
+    model = build_model(cfg)
+    state = _restored_state(cfg, model, args.restore_step)
+    vocoder = None if args.griffin_lim else get_vocoder(
+        cfg, args.vocoder_ckpt, config_path=args.vocoder_config
+    )
+
+    ds = SpeechDataset(args.split, cfg, sort=False, drop_last=False)
+    batcher = BucketedBatcher(
+        ds, max_src=cfg.model.max_seq_len, max_mel=cfg.model.max_seq_len
+    )
+
+    rows, done = [], 0
+    for batch in batcher.epoch(shuffle=False):
+        if done >= args.n_utts:
+            break
+        arrays = batch.arrays()
+        out = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            speakers=arrays["speakers"], texts=arrays["texts"],
+            src_lens=arrays["src_lens"], mels=arrays["mels"],
+            mel_lens=arrays["mel_lens"],
+            max_mel_len=arrays["mels"].shape[1],
+            deterministic=True,
+        )
+        # tail items can be all-padding bucket fillers, and the last batch
+        # may exceed what --n_utts still needs — don't vocode the excess
+        n = min(batch.n_real, args.n_utts - done)
+        mels_syn = np.asarray(out["mel_postnet"], np.float32)[:n]
+        # >=8 frames (> n_fft/hop): an untrained duration predictor can
+        # emit 0-length mels, below what the vocoders/istft can consume
+        lens_syn = np.maximum(np.asarray(out["mel_lens"])[:n], 8)
+        mels_gt = np.asarray(arrays["mels"], np.float32)[:n]
+        lens_gt = np.asarray(arrays["mel_lens"])[:n]
+        wavs_gt = _vocode(cfg, vocoder, mels_gt, lengths=lens_gt)
+        wavs_syn = _vocode(cfg, vocoder, mels_syn, lengths=lens_syn)
+        for i in range(batch.n_real):
+            if done >= args.n_utts:
+                break
+            uid = batch.ids[i]
+            text = batch.raw_texts[i] if batch.raw_texts else uid
+            pairs = (
+                (f"{uid}_ground-truth.wav", wavs_gt[i]),
+                (f"{uid}_synthesized.wav", wavs_syn[i]),
+            )
+            for fname, wav in pairs:
+                save_wav(
+                    os.path.join(out_dir, fname),
+                    np.asarray(wav, np.float32)
+                    / pp.audio.max_wav_value,
+                    pp.audio.sampling_rate,
+                )
+            rows.append(ROW.format(
+                text=html.escape(text),
+                gt=f"{dataset}/{pairs[0][0]}",
+                syn=f"{dataset}/{pairs[1][0]}",
+            ))
+            done += 1
+            print(f"[{done}/{args.n_utts}] {uid}")
+
+    page = PAGE.format(
+        n=done, dataset=dataset,
+        step=int(state.step), rows="\n".join(rows),
+    )
+    index = os.path.join(args.out, "index.html")
+    with open(index, "w") as f:
+        f.write(page)
+    print(f"wrote {index} ({done} utterances)")
+
+
+if __name__ == "__main__":
+    main()

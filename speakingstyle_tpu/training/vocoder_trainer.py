@@ -90,16 +90,20 @@ def differentiable_mel(cfg: Config):
 def init_vocoder_state(
     cfg: Config, hp: VocoderHParams, rng, gen_params: Optional[Dict] = None,
     gen: Optional[Generator] = None,
+    mpd: Optional[MultiPeriodDiscriminator] = None,
+    msd: Optional[MultiScaleDiscriminator] = None,
 ) -> Tuple[VocoderState, Generator, MultiPeriodDiscriminator, MultiScaleDiscriminator, optax.GradientTransformation, optax.GradientTransformation]:
     """Build models + optimizers; ``gen_params`` warm-starts the generator
     (fine-tuning a converted checkpoint). Pass ``gen`` (e.g. from
     ``hifigan.generator_from_config`` on the checkpoint's config.json) when
     fine-tuning a non-default topology — V3/ResBlock2, different upsample
-    rates — so the module matches the warm-start params."""
+    rates — so the module matches the warm-start params. ``mpd``/``msd``
+    likewise override the discriminator topology (fewer periods/scales for
+    cheap experiments; the defaults are the reference recipe)."""
     n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
     gen = gen if gen is not None else Generator()
-    mpd = MultiPeriodDiscriminator()
-    msd = MultiScaleDiscriminator()
+    mpd = mpd if mpd is not None else MultiPeriodDiscriminator()
+    msd = msd if msd is not None else MultiScaleDiscriminator()
     k1, k2, k3 = jax.random.split(rng, 3)
     seg = hp.segment_size
     hop = cfg.preprocess.preprocessing.stft.hop_length
