@@ -42,6 +42,7 @@ def _small_discs():
     )
 
 
+@pytest.mark.slow
 def test_default_discriminator_topology():
     """The reference topology (5 periods incl. the prime-11 padding path,
     3 scales incl. the twice-pooled one) forwards with the right number
