@@ -496,15 +496,19 @@ def run_ab():
     """A/B the performance knobs (README "Performance knobs"): one process
     per variant so each gets a clean backend; prints one JSON line each."""
     variants = [
-        # every variant pins attention_kernel explicitly: the ModelConfig
-        # default is now "fused", so the einsum rows ARE the overrides
+        # every variant pins its knobs explicitly against the r5 tuned set
+        # (TUNED_OVERRIDES); the rows walk one knob away from it at a time
+        # plus the historical conv/attention matrix. Measured results for
+        # all of these live in PERF.md.
+        dict(TUNED_OVERRIDES),
+        dict(TUNED_OVERRIDES, dropout_impl="bernoulli"),
+        dict(TUNED_OVERRIDES, fused_optimizer=False),
+        dict(TUNED_OVERRIDES, conv_impl="pallas"),
         {"conv_impl": "xla", "attention_kernel": "einsum"},
         {"conv_impl": "unfold", "attention_kernel": "einsum"},
         {"conv_impl": "pallas", "attention_kernel": "einsum"},
         {"conv_impl": "xla", "attention_kernel": "einsum",
          "attention_softmax_dtype": "bfloat16"},
-        {"conv_impl": "xla", "attention_kernel": "fused"},
-        {"conv_impl": "pallas", "attention_kernel": "fused"},
     ]
     for ov in variants:
         try:
