@@ -1,0 +1,22 @@
+#!/usr/bin/env python
+"""Repo-root wrapper for the jaxlint static analyzer (CI entry point).
+
+    python scripts/lint_jax.py --check            # CI gate
+    python scripts/lint_jax.py --list-rules       # rule catalog
+    python scripts/lint_jax.py --update-baseline  # after reviewing findings
+    python scripts/lint_jax.py path/to/file.py    # lint one file
+
+Exit 0 = clean modulo the committed baseline
+(speakingstyle_tpu/analysis/baseline.json); nonzero otherwise. See the
+"Analysis & invariants" section of ARCHITECTURE.md.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from speakingstyle_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

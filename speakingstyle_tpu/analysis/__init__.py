@@ -1,0 +1,27 @@
+"""Static analysis + runtime contracts for JAX/TPU invariants.
+
+Two complementary layers:
+
+* ``speakingstyle_tpu.analysis`` (jaxlint) — an AST linter enforcing the
+  throughput-critical invariants no generic Python linter knows about:
+  trace-unsafe control flow (JL001), numpy-on-device-arrays (JL002),
+  missing donation (JL003), host syncs in training loops (JL004),
+  recompilation hazards (JL005), PRNG key reuse (JL006). Run it via
+  ``python scripts/lint_jax.py --check`` or
+  ``python -m speakingstyle_tpu.analysis.cli``.
+* ``speakingstyle_tpu.analysis.contracts`` — chex-style runtime
+  shape/dtype/finiteness assertions wired into the model/training entry
+  points; no-ops unless ``SPEAKINGSTYLE_CHECKS=1``.
+"""
+
+from speakingstyle_tpu.analysis.linter import (  # noqa: F401
+    compare_to_baseline,
+    default_baseline_path,
+    default_lint_paths,
+    findings_counter,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    save_baseline,
+)
+from speakingstyle_tpu.analysis.rules import RULES, Finding  # noqa: F401

@@ -1,0 +1,119 @@
+"""jaxlint command line: ``python -m speakingstyle_tpu.analysis.cli``.
+
+Exit codes: 0 = clean modulo the committed baseline; 1 = new findings
+and/or stale baseline entries (both directions fail loudly); 2 = usage
+error. ``scripts/lint_jax.py`` is the repo-root wrapper for CI.
+"""
+
+import argparse
+import sys
+
+from speakingstyle_tpu.analysis import linter
+from speakingstyle_tpu.analysis.rules import RULES
+
+
+def _print_rules():
+    for code, rule in sorted(RULES.items()):
+        doc = (rule.__doc__ or "").strip().splitlines()
+        head = doc[0] if doc else ""
+        print(f"{code}  {head}")
+        for line in doc[1:]:
+            print(f"       {line.strip()}")
+        print()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m speakingstyle_tpu.analysis.cli",
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="files/directories to lint (default: the repo's "
+             "speakingstyle_tpu/, scripts/, tests/, bench.py)",
+    )
+    ap.add_argument(
+        "--check", action="store_true",
+        help="CI mode: succeed only if clean modulo the baseline "
+             "(stale baseline entries also fail)",
+    )
+    ap.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file (default: {linter.default_baseline_path()})",
+    )
+    ap.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report every finding",
+    )
+    ap.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline from the current findings and exit 0",
+    )
+    ap.add_argument(
+        "--select", default=None,
+        help="comma-separated rule codes to run (default: all)",
+    )
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        _print_rules()
+        return 0
+
+    select = None
+    if args.select:
+        select = {r.strip().upper() for r in args.select.split(",")}
+        unknown = select - set(RULES)
+        if unknown:
+            print(f"unknown rules: {sorted(unknown)}", file=sys.stderr)
+            return 2
+
+    findings = linter.lint_paths(args.paths or None, select=select)
+
+    if args.update_baseline:
+        linter.save_baseline(findings, args.baseline)
+        print(
+            f"baseline updated: {len(findings)} findings -> "
+            f"{args.baseline or linter.default_baseline_path()}"
+        )
+        return 0
+
+    baseline = (
+        linter.load_baseline(args.baseline)
+        if not args.no_baseline
+        else linter.findings_counter([])
+    )
+    new, stale = linter.compare_to_baseline(findings, baseline)
+
+    by_fp = {}
+    for f in findings:
+        by_fp.setdefault(f.fingerprint, []).append(f)
+
+    shown = 0
+    for fp in sorted(new):
+        for f in by_fp[fp][: new[fp]]:
+            print(f"{f.path}:{f.line}: {f.rule} {f.message}")
+            shown += 1
+    baselined = len(findings) - shown
+    if stale:
+        print(
+            f"\nSTALE baseline entries (fixed in code, still listed — run "
+            "--update-baseline and commit the diff):",
+            file=sys.stderr,
+        )
+        for fp in sorted(stale):
+            print(f"  {fp} (x{stale[fp]})", file=sys.stderr)
+
+    summary = (
+        f"{shown} finding(s) over baseline, {baselined} baselined, "
+        f"{len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'}"
+    )
+    print(("FAIL: " if (new or stale) else "OK: ") + summary,
+          file=sys.stderr if (new or stale) else sys.stdout)
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,118 @@
+"""Runtime shape/dtype contracts (chex-style, zero-cost when disabled).
+
+The static linter (``speakingstyle_tpu.analysis``) catches structural
+TPU-safety hazards; this module covers the complementary dynamic class —
+wrong shapes/dtypes threaded through the model entry points, and NaN/Inf
+trees at host boundaries. Every helper is a no-op unless the environment
+variable ``SPEAKINGSTYLE_CHECKS=1`` is set when the process starts, so the
+hot path compiles to exactly the same jaxpr in production.
+
+Design rules:
+  * Shape/dtype/rank checks read only static metadata (``.shape``,
+    ``.dtype``) — they work identically on concrete arrays and tracers,
+    and inside ``jax.jit`` they fail at trace time, not run time.
+  * ``assert_tree_finite`` needs values, so it silently skips tracers:
+    inside a jitted function it is a no-op (no host sync is ever
+    introduced); call it at host boundaries (checkpoint save, logging).
+  * Failures raise ``ContractError`` (an ``AssertionError`` subclass) with
+    the offending name, expected spec, and actual metadata.
+
+Enablement is snapshotted at import (``ENABLED``); tests flip the module
+attribute directly instead of re-importing.
+"""
+
+import os
+
+ENABLED = os.environ.get("SPEAKINGSTYLE_CHECKS", "") == "1"
+
+
+class ContractError(AssertionError):
+    """A runtime shape/dtype/finiteness contract was violated."""
+
+
+def checks_enabled() -> bool:
+    return ENABLED
+
+
+def assert_rank(x, rank: int, name: str = "array"):
+    """``x.ndim == rank``; None passes (optional inputs)."""
+    if not ENABLED or x is None:
+        return x
+    actual = getattr(x, "ndim", None)
+    if actual is None:
+        actual = len(getattr(x, "shape", ()))
+    if actual != rank:
+        raise ContractError(
+            f"{name}: expected rank {rank}, got rank {actual} "
+            f"(shape {tuple(getattr(x, 'shape', ()))})"
+        )
+    return x
+
+
+def assert_shape(x, shape, name: str = "array"):
+    """``x.shape`` matches ``shape``; ``None`` entries are wildcards.
+
+    ``assert_shape(x, (None, 80))`` accepts any [B, 80]. None ``x`` passes.
+    """
+    if not ENABLED or x is None:
+        return x
+    actual = tuple(getattr(x, "shape", ()))
+    ok = len(actual) == len(shape) and all(
+        want is None or want == got for want, got in zip(shape, actual)
+    )
+    if not ok:
+        raise ContractError(
+            f"{name}: expected shape {tuple(shape)}, got {actual}"
+        )
+    return x
+
+
+def assert_dtype(x, dtype, name: str = "array"):
+    """``x.dtype`` matches ``dtype``.
+
+    ``dtype`` may be a concrete dtype (``jnp.float32``) or one of the
+    category strings ``"integer"`` / ``"floating"`` / ``"bool"``
+    (checked via ``jnp.issubdtype``). None ``x`` passes.
+    """
+    if not ENABLED or x is None:
+        return x
+    import jax.numpy as jnp
+
+    actual = jnp.dtype(getattr(x, "dtype", type(x)))
+    if dtype == "integer":
+        ok = jnp.issubdtype(actual, jnp.integer)
+    elif dtype == "floating":
+        ok = jnp.issubdtype(actual, jnp.floating)
+    elif dtype == "bool":
+        ok = actual == jnp.bool_
+    else:
+        ok = actual == jnp.dtype(dtype)
+    if not ok:
+        raise ContractError(f"{name}: expected dtype {dtype}, got {actual}")
+    return x
+
+
+def assert_tree_finite(tree, name: str = "tree"):
+    """Every concrete leaf of ``tree`` is finite (no NaN/Inf).
+
+    Tracer leaves are skipped, so this is safe (and free) inside jitted
+    code; use it at host boundaries where values are materialized anyway.
+    """
+    if not ENABLED or tree is None:
+        return tree
+    import jax
+    import numpy as np
+
+    bad = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        if isinstance(leaf, jax.core.Tracer):
+            continue
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating) and not np.isfinite(arr).all():
+            bad.append(jax.tree_util.keystr(path))
+    if bad:
+        raise ContractError(
+            f"{name}: non-finite values in {len(bad)} leaves: "
+            f"{bad[:5]}{'...' if len(bad) > 5 else ''}"
+        )
+    return tree

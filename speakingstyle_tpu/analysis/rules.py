@@ -1,0 +1,987 @@
+"""AST rule implementations for the jaxlint static analyzer.
+
+Every rule is a function ``(module: ModuleInfo) -> Iterator[Finding]``
+registered in ``RULES``. Rules are deliberately heuristic: they resolve
+names lexically within one file (no imports, no cross-file types), which
+is exactly enough for the hazard classes that destroy TPU throughput —
+each is a *syntactic* pattern. Conservative over-reporting is handled by
+the committed baseline (tracked-but-allowed) and inline
+``# jaxlint: disable=JLxxx`` suppressions, never by weakening a rule to
+silence.
+
+Rule catalog (docstrings are the user-facing documentation; the CLI's
+``--list-rules`` prints them):
+
+JL001  trace-unsafe Python control flow in traced contexts
+JL002  numpy applied to JAX arrays (host fallback / implicit transfer)
+JL003  missing donation on state-updating jits; unhashable static args
+JL004  host-device sync inside training loops
+JL005  recompilation hazards in jitted signatures
+JL006  PRNG key reuse without split
+"""
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# shared model
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    context: str  # enclosing function qualname (or "<module>")
+    detail: str  # short, line-number-free (stable across edits)
+    message: str  # full human-readable text
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.rule}:{self.path}:{self.context}:{self.detail}"
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted-name of an expression (``jax.random.split``)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted(node.value)
+        return f"{base}.{node.attr}" if base else node.attr
+    if isinstance(node, ast.Call):
+        return _dotted(node.func)
+    return ""
+
+
+def _names_in(node: ast.AST) -> Set[str]:
+    return {
+        n.id for n in ast.walk(node) if isinstance(n, ast.Name)
+    }
+
+
+# calls whose result is a jax array (lexical heuristics)
+_ARRAY_PRODUCER_PREFIXES = (
+    "jnp.", "jax.numpy.", "jax.nn.", "jax.lax.", "jax.random.",
+)
+_ARRAY_PRODUCER_SUFFIXES = (".apply", ".init")
+
+# jax transforms whose function argument is traced
+_TRACING_TRANSFORMS = {
+    "jax.jit", "jax.grad", "jax.value_and_grad", "jax.vmap", "jax.pmap",
+    "jax.checkpoint", "jax.remat",
+}
+
+_STATE_PARAM_NAMES = {"state", "variables", "params", "opt_state", "carry"}
+
+_HOST_SYNC_CALLS = {"jax.device_get", "jax.block_until_ready"}
+
+_CONFIG_PARAM_NAMES = {"cfg", "config", "hp", "hparams", "hyper_params"}
+
+_DICTISH_ANNOTATIONS = {"dict", "Dict", "list", "List", "Mapping", "Any"}
+
+_RNG_DERIVERS = {"jax.random.split", "jax.random.fold_in", "jax.random.clone"}
+
+
+class ModuleInfo:
+    """One parsed file plus the pre-analysis every rule shares."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.tree = tree
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+
+        self.functions: List[ast.FunctionDef] = [
+            n for n in ast.walk(tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        self._jitted_names = self._collect_jitted_names()
+        self._partial_static_params = self._collect_partial_bindings()
+        self._traced = {f for f in self.functions if self._is_traced(f)}
+
+    # -- context helpers ----------------------------------------------------
+
+    def qualname(self, node: ast.AST) -> str:
+        parts: List[str] = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts)) or "<module>"
+
+    def enclosing_function(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_loops(self, node: ast.AST) -> List[ast.AST]:
+        out = []
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.For, ast.While, ast.AsyncFor)):
+                out.append(cur)
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            cur = self.parents.get(cur)
+        return out
+
+    # -- traced-context detection -------------------------------------------
+
+    def _collect_jitted_names(self) -> Set[str]:
+        """Function names that appear as the traced argument of a jax
+        transform call anywhere in the file: ``jax.jit(step_fn, ...)``."""
+        names: Set[str] = set()
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _dotted(node.func)
+            if callee in _TRACING_TRANSFORMS or (
+                callee in ("functools.partial", "partial")
+                and node.args
+                and _dotted(node.args[0]) in _TRACING_TRANSFORMS
+            ):
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        names.add(arg.id)
+        return names
+
+    def _collect_partial_bindings(self) -> Dict[str, Set[str]]:
+        """functools.partial(f, kw=..., pos...) binds those params of ``f``
+        statically — they are Python values at trace time, not tracers."""
+        out: Dict[str, Set[str]] = {}
+        defs = {f.name: f for f in self.functions}
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _dotted(node.func) not in ("functools.partial", "partial"):
+                continue
+            if not node.args or not isinstance(node.args[0], ast.Name):
+                continue
+            fn = defs.get(node.args[0].id)
+            if fn is None:
+                continue
+            bound = out.setdefault(fn.name, set())
+            params = [a.arg for a in fn.args.args]
+            for i, _ in enumerate(node.args[1:]):
+                if i < len(params):
+                    bound.add(params[i])
+            for kw in node.keywords:
+                if kw.arg:
+                    bound.add(kw.arg)
+        return out
+
+    def _is_traced(self, fn: ast.FunctionDef) -> bool:
+        for dec in fn.decorator_list:
+            d = _dotted(dec)
+            if d in _TRACING_TRANSFORMS or d in ("nn.compact", "nn.remat"):
+                return True
+            if isinstance(dec, ast.Call):
+                dc = _dotted(dec.func)
+                if dc in _TRACING_TRANSFORMS:
+                    return True
+                if dc in ("functools.partial", "partial") and dec.args and \
+                        _dotted(dec.args[0]) in _TRACING_TRANSFORMS:
+                    return True
+        if fn.name in self._jitted_names:
+            return True
+        # __call__ / compact methods of nn.Module subclasses
+        parent = self.parents.get(fn)
+        if isinstance(parent, ast.ClassDef):
+            bases = {_dotted(b) for b in parent.bases}
+            if any(b.endswith("Module") for b in bases):
+                if fn.name == "__call__" or any(
+                    _dotted(d) == "nn.compact" for d in fn.decorator_list
+                ):
+                    return True
+        return False
+
+    def is_in_traced_context(self, node: ast.AST) -> bool:
+        """True if ``node`` sits inside a traced function (nested defs
+        inside a traced function execute at trace time too)."""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                    cur in self._traced:
+                return True
+            cur = self.parents.get(cur)
+        return False
+
+    # -- per-function dataflow ----------------------------------------------
+
+    def array_locals(self, fn: ast.FunctionDef) -> Set[str]:
+        """Names assigned (anywhere in ``fn``) from expressions that produce
+        jax arrays: jnp./jax.lax./..., ``.apply(...)``/``.init(...)`` calls,
+        or calls of locally-jitted callables."""
+        producers: Set[str] = set()
+        jitted_locals = set(self._jitted_names)
+        # names bound directly to a jit wrapper: g = jax.jit(...)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if _dotted(node.value.func) in _TRACING_TRANSFORMS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            jitted_locals.add(t.id)
+        # locally @jax.jit-decorated defs
+        for sub in ast.walk(fn):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                    sub in self._traced:
+                jitted_locals.add(sub.name)
+
+        def produces_array(value: ast.AST) -> bool:
+            if not isinstance(value, ast.Call):
+                return False
+            callee = _dotted(value.func)
+            if callee.startswith(_ARRAY_PRODUCER_PREFIXES):
+                return True
+            if any(callee.endswith(s) for s in _ARRAY_PRODUCER_SUFFIXES):
+                return True
+            return callee in jitted_locals
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and produces_array(node.value):
+                for t in node.targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            producers.add(n.id)
+        return producers
+
+    def static_params(self, fn: ast.FunctionDef) -> Set[str]:
+        """Params known static at trace time: ``self``, partial-bound
+        params, and str/int-annotated ones (shape-like by convention)."""
+        static = {"self"}
+        static |= self._partial_static_params.get(fn.name, set())
+        for a in list(fn.args.args) + list(fn.args.kwonlyargs):
+            ann = a.annotation
+            if ann is not None:
+                t = _dotted(ann)
+                if isinstance(ann, ast.Subscript):  # Optional[int] etc.
+                    t = f"{_dotted(ann.value)}[{_dotted(ann.slice)}]"
+                if t in ("str", "int", "Optional[int]", "Optional[str]"):
+                    static.add(a.arg)
+        return static
+
+
+# ---------------------------------------------------------------------------
+# JL001 — trace-unsafe Python control flow
+# ---------------------------------------------------------------------------
+
+_SAFE_CALLS = {
+    "isinstance", "len", "hasattr", "getattr", "callable", "issubclass",
+    "jnp.issubdtype", "jax.numpy.issubdtype",
+}
+_SAFE_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+
+
+def _suspicious_names(test: ast.AST, suspects: Set[str]) -> Set[str]:
+    """Bare Name loads from ``suspects`` in ``test``, after pruning
+    trace-safe subexpressions (identity checks, metadata attrs, string
+    comparisons, isinstance/len)."""
+
+    pruned: Set[ast.AST] = set()
+
+    def prune(node: ast.AST):
+        for child in ast.walk(node):
+            pruned.add(child)
+
+    for node in ast.walk(test):
+        if node in pruned:
+            continue
+        if isinstance(node, ast.Compare):
+            ops_safe = all(isinstance(o, (ast.Is, ast.IsNot)) for o in node.ops)
+            str_cmp = any(
+                isinstance(c, ast.Constant) and isinstance(c.value, (str, bytes))
+                for c in [node.left] + list(node.comparators)
+            )
+            if ops_safe or str_cmp:
+                prune(node)
+        elif isinstance(node, ast.Call) and _dotted(node.func) in _SAFE_CALLS:
+            prune(node)
+        elif isinstance(node, ast.Attribute):
+            if node.attr in _SAFE_ATTRS:
+                prune(node)
+            else:
+                # attribute access on a name (cfg.multi_speaker, self.rate)
+                # reads config, not array truthiness — prune the VALUE name
+                # but keep walking anything deeper than a plain name chain
+                if isinstance(node.value, ast.Name):
+                    pruned.add(node.value)
+
+    out = set()
+    for node in ast.walk(test):
+        if node in pruned:
+            continue
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id in suspects:
+                out.add(node.id)
+    return out
+
+
+def rule_jl001(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL001: Python ``if``/``while``/``assert`` on a potentially traced
+    value inside a traced context (@jax.jit functions, functions passed to
+    jax transforms, nn.Module ``__call__``/@nn.compact bodies).
+
+    Python branching executes at trace time: on a tracer it raises
+    ``TracerBoolConversionError``; on a Python value it silently bakes one
+    branch into the compiled program. Parameters of traced functions are
+    traced unless marked static (bool flags included — ``donate``/``jit``
+    do NOT make bools static), so branch on ``self.*`` config, mark the
+    argument static, or use ``jax.lax.cond``/``jnp.where``.
+    """
+    for fn in mod.functions:
+        if fn not in mod._traced:
+            continue
+        static = mod.static_params(fn)
+        params = {
+            a.arg
+            for a in list(fn.args.args) + list(fn.args.kwonlyargs)
+            + ([fn.args.vararg] if fn.args.vararg else [])
+            + ([fn.args.kwarg] if fn.args.kwarg else [])
+        } - static
+        arrays = mod.array_locals(fn)
+        suspects = params | arrays
+        qual = mod.qualname(fn)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                test = node.test
+                kind = "if" if isinstance(node, ast.If) else "while"
+            elif isinstance(node, ast.Assert):
+                test, kind = node.test, "assert"
+            else:
+                continue
+            hits = _suspicious_names(test, suspects)
+            # direct jnp./jax. calls in the test are traced values too
+            for call in ast.walk(test):
+                if isinstance(call, ast.Call) and _dotted(call.func).startswith(
+                    _ARRAY_PRODUCER_PREFIXES
+                ):
+                    hits.add(_dotted(call.func))
+            for name in sorted(hits):
+                yield Finding(
+                    rule="JL001",
+                    path=mod.path,
+                    line=node.lineno,
+                    context=qual,
+                    detail=f"{kind} on {name!r}",
+                    message=(
+                        f"Python `{kind}` on {name!r} inside traced context "
+                        f"{qual}: traced values cannot drive Python control "
+                        "flow — use jax.lax.cond/jnp.where, mark the "
+                        "argument static, or branch on self.* config."
+                    ),
+                )
+
+
+# ---------------------------------------------------------------------------
+# JL002 — numpy on jax arrays
+# ---------------------------------------------------------------------------
+
+
+def rule_jl002(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL002: ``np.*`` applied to a value produced by jax (jnp/jax.lax/
+    jax.random calls, ``.apply``/``.init``, or a jitted callable).
+
+    Inside a traced context this is a host fallback that breaks tracing or
+    silently constant-folds; outside, it is an implicit device->host
+    transfer (a sync point) that belongs at explicit boundaries only.
+    Test files are exempt: round-tripping through numpy is the assertion
+    idiom there, and np.testing.* transfers on purpose everywhere.
+    """
+    p = mod.path.replace("\\", "/")
+    if "tests/" in p or os.path.basename(p).startswith("test_"):
+        return
+    for fn in mod.functions:
+        arrays = mod.array_locals(fn)
+        if not arrays:
+            continue
+        qual = mod.qualname(fn)
+        traced = mod.is_in_traced_context(fn.body[0]) if fn.body else False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _dotted(node.func)
+            if not (callee.startswith("np.") or callee.startswith("numpy.")):
+                continue
+            if callee.startswith("np.testing") or callee.startswith(
+                "numpy.testing"
+            ):
+                continue  # test assertions transfer on purpose
+            used = set()
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                used |= _names_in(arg) & arrays
+            for name in sorted(used):
+                where = (
+                    "inside a traced context (host fallback breaks tracing)"
+                    if traced
+                    else "an implicit device->host transfer (sync point)"
+                )
+                yield Finding(
+                    rule="JL002",
+                    path=mod.path,
+                    line=node.lineno,
+                    context=qual,
+                    detail=f"{callee} on {name!r}",
+                    message=(
+                        f"`{callee}` applied to jax array {name!r} in {qual}: "
+                        f"{where}. Use jnp.* on device, or jax.device_get at "
+                        "an explicit boundary."
+                    ),
+                )
+
+
+# ---------------------------------------------------------------------------
+# JL003 — donation / static hashability
+# ---------------------------------------------------------------------------
+
+
+def _jit_callsites(mod: ModuleInfo):
+    """Yield (call_node, callee_fndef_or_None, jit_kwargs, decorated_fn).
+
+    Covers ``jax.jit(f, **kw)`` calls, ``@jax.jit`` and
+    ``@functools.partial(jax.jit, **kw)`` decorations.
+    """
+    defs = {f.name: f for f in mod.functions}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and _dotted(node.func) == "jax.jit":
+            target = None
+            if node.args and isinstance(node.args[0], ast.Name):
+                target = defs.get(node.args[0].id)
+            kwargs = {k.arg for k in node.keywords if k.arg}
+            yield node, target, kwargs, None
+    for fn in mod.functions:
+        for dec in fn.decorator_list:
+            if _dotted(dec) == "jax.jit":
+                yield dec, fn, set(), fn
+            elif isinstance(dec, ast.Call):
+                dc = _dotted(dec.func)
+                if dc == "jax.jit":
+                    yield dec, fn, {k.arg for k in dec.keywords if k.arg}, fn
+                elif dc in ("functools.partial", "partial") and dec.args and \
+                        _dotted(dec.args[0]) == "jax.jit":
+                    yield dec, fn, {k.arg for k in dec.keywords if k.arg}, fn
+
+
+def _is_state_update_shaped(fn: ast.FunctionDef, state_params: Set[str]) -> bool:
+    """Does ``fn`` return an updated copy of a state-like parameter?"""
+
+    updated: Set[str] = set()
+
+    def is_update_expr(expr: ast.AST) -> bool:
+        if isinstance(expr, ast.Call):
+            callee = _dotted(expr.func)
+            head = callee.split(".")[0]
+            if callee.endswith(".replace") and head in state_params:
+                return True
+            if callee in ("optax.apply_updates",):
+                return True
+            # SomeState(**restored)-style reconstruction mentioning state
+            if callee and callee[0].isupper() and "State" in callee:
+                return True
+        if isinstance(expr, ast.Dict):
+            for k, v in zip(expr.keys, expr.values):
+                # {**state, ...}: a copied-and-updated state dict
+                if k is None and isinstance(v, ast.Name) and \
+                        v.id in state_params:
+                    return True
+        return False
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and is_update_expr(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    updated.add(t.id)
+
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        values = (
+            list(node.value.elts)
+            if isinstance(node.value, ast.Tuple)
+            else [node.value]
+        )
+        for v in values:
+            if is_update_expr(v):
+                return True
+            if isinstance(v, ast.Name) and v.id in updated:
+                return True
+    return False
+
+
+def rule_jl003(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL003: (a) ``jax.jit`` of a train-step-shaped function (takes a
+    state-like argument and returns an updated copy of it) without
+    ``donate_argnums``/``donate_argnames`` — without donation every step
+    holds two copies of the full state in HBM and pays an extra copy;
+    (b) list/dict/set literals passed in ``static_argnums`` positions —
+    unhashable statics raise at call time.
+    """
+    seen: Set[int] = set()
+    for node, target, kwargs, _ in _jit_callsites(mod):
+        if target is None or id(target) in seen:
+            continue
+        state_params = {
+            a.arg
+            for a in target.args.args
+            if a.arg in _STATE_PARAM_NAMES or a.arg.endswith("_state")
+        }
+        if not state_params:
+            continue
+        if not _is_state_update_shaped(target, state_params):
+            continue
+        seen.add(id(target))
+        if not (kwargs & {"donate_argnums", "donate_argnames"}):
+            yield Finding(
+                rule="JL003",
+                path=mod.path,
+                line=node.lineno,
+                context=mod.qualname(target),
+                detail=f"jit of state-updating {target.name!r} without donation",
+                message=(
+                    f"jax.jit({target.name}) updates {sorted(state_params)} "
+                    "but does not donate it: pass donate_argnums so XLA can "
+                    "reuse the input buffers instead of holding two copies "
+                    "of the state."
+                ),
+            )
+
+    # (b) unhashable literals at static positions
+    static_of: Dict[str, List[int]] = {}
+    for node, target, _, decorated in _jit_callsites(mod):
+        call = node if isinstance(node, ast.Call) else None
+        if call is None:
+            continue
+        for k in call.keywords:
+            if k.arg == "static_argnums":
+                idxs = []
+                vals = (
+                    k.value.elts
+                    if isinstance(k.value, (ast.Tuple, ast.List))
+                    else [k.value]
+                )
+                for v in vals:
+                    if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                        idxs.append(v.value)
+                name = None
+                if decorated is not None:
+                    name = decorated.name
+                else:
+                    parent = mod.parents.get(call)
+                    if isinstance(parent, ast.Assign):
+                        for t in parent.targets:
+                            if isinstance(t, ast.Name):
+                                name = t.id
+                if name and idxs:
+                    static_of[name] = idxs
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Name):
+            continue
+        idxs = static_of.get(node.func.id)
+        if not idxs:
+            continue
+        for i in idxs:
+            if i < len(node.args) and isinstance(
+                node.args[i], (ast.List, ast.Dict, ast.Set)
+            ):
+                kind = type(node.args[i]).__name__.lower()
+                yield Finding(
+                    rule="JL003",
+                    path=mod.path,
+                    line=node.lineno,
+                    context=mod.qualname(
+                        mod.enclosing_function(node) or mod.tree
+                    ),
+                    detail=f"unhashable {kind} at static arg {i} of "
+                           f"{node.func.id!r}",
+                    message=(
+                        f"call of jitted {node.func.id!r} passes a {kind} "
+                        f"literal at static_argnums position {i}: statics "
+                        "must be hashable — use a tuple/frozen dataclass."
+                    ),
+                )
+
+
+# ---------------------------------------------------------------------------
+# JL004 — host sync inside training loops
+# ---------------------------------------------------------------------------
+
+
+def rule_jl004(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL004: host-device synchronization inside a loop in ``training/``
+    code: ``.item()``, ``float()``/``int()`` on non-constants,
+    ``jax.device_get``, ``(jax.)block_until_ready``.
+
+    Each of these drains the dispatch queue: the device goes idle until
+    the host catches up, which serializes the step pipeline. Deliberate,
+    rate-gated syncs (logging every N steps) belong in the baseline or
+    under an inline disable with the gate visible on the same line.
+    """
+    if "training/" not in mod.path.replace("\\", "/"):
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not mod.enclosing_loops(node):
+            continue
+        callee = _dotted(node.func)
+        detail = None
+        if callee in _HOST_SYNC_CALLS:
+            detail = callee
+        elif isinstance(node.func, ast.Attribute) and node.func.attr in (
+            "item", "block_until_ready"
+        ):
+            detail = f".{node.func.attr}()"
+        elif isinstance(node.func, ast.Name) and node.func.id in (
+            "float", "int"
+        ):
+            if node.args and not isinstance(node.args[0], ast.Constant):
+                arg_callee = _dotted(node.args[0])
+                if not arg_callee.startswith(("time.", "len", "os.")):
+                    detail = f"{node.func.id}() on device value"
+        if detail is None:
+            continue
+        fn = mod.enclosing_function(node)
+        yield Finding(
+            rule="JL004",
+            path=mod.path,
+            line=node.lineno,
+            context=mod.qualname(fn or mod.tree),
+            detail=f"host sync {detail} in loop",
+            message=(
+                f"host sync `{detail}` inside a loop in "
+                f"{mod.qualname(fn or mod.tree)}: this blocks the dispatch "
+                "queue every iteration — hoist it, gate it on a log step, "
+                "or keep the value on device."
+            ),
+        )
+
+
+# ---------------------------------------------------------------------------
+# JL005 — recompilation hazards
+# ---------------------------------------------------------------------------
+
+
+def rule_jl005(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL005: recompilation hazards at jit boundaries: (a) dict/list-typed
+    parameters in jitted signatures — every distinct key set or leaf shape
+    retraces; (b) config-named parameters (cfg/config/hparams/...) —
+    thread config by closure, not as a traced argument; (c) Python scalar
+    defaults on non-static jitted parameters — weak-type churn retraces on
+    the first call that passes a concrete dtype; (d) ``jax.jit`` applied
+    inside a loop body — a fresh wrapper (usually over a fresh closure)
+    retraces and recompiles every iteration.
+    """
+    seen: Set[int] = set()
+    for node, target, kwargs_, decorated in _jit_callsites(mod):
+        if target is None or id(target) in seen:
+            continue
+        seen.add(id(target))
+        qual = mod.qualname(target)
+        static: Set[str] = set()
+        call = node if isinstance(node, ast.Call) else None
+        static_idxs: List[int] = []
+        if call is not None:
+            for k in call.keywords:
+                if k.arg == "static_argnums":
+                    vals = (
+                        k.value.elts
+                        if isinstance(k.value, (ast.Tuple, ast.List))
+                        else [k.value]
+                    )
+                    static_idxs = [
+                        v.value
+                        for v in vals
+                        if isinstance(v, ast.Constant)
+                        and isinstance(v.value, int)
+                    ]
+                if k.arg == "static_argnames":
+                    for v in ast.walk(k.value):
+                        if isinstance(v, ast.Constant) and isinstance(
+                            v.value, str
+                        ):
+                            static.add(v.value)
+        params = list(target.args.args)
+        for i in static_idxs:
+            if i < len(params):
+                static.add(params[i].arg)
+
+        defaults = target.args.defaults
+        defaulted = params[len(params) - len(defaults):] if defaults else []
+        for a, d in zip(defaulted, defaults):
+            if a.arg in static:
+                continue
+            # bools excluded: flag-shaped defaults are JL001's territory
+            if isinstance(d, ast.Constant) and isinstance(
+                d.value, (int, float)
+            ) and not isinstance(d.value, bool):
+                yield Finding(
+                    rule="JL005",
+                    path=mod.path,
+                    line=a.lineno,
+                    context=qual,
+                    detail=f"python scalar param {a.arg!r} in jitted signature",
+                    message=(
+                        f"jitted {target.name!r} takes Python scalar "
+                        f"{a.arg!r} (default {d.value!r}) as a traced arg: "
+                        "weak-type promotion retraces when callers pass "
+                        "arrays vs literals — mark it static or pass "
+                        "jnp.asarray values."
+                    ),
+                )
+        for a in params:
+            if a.arg in static:
+                continue
+            ann = _dotted(a.annotation) if a.annotation is not None else ""
+            if ann in _DICTISH_ANNOTATIONS and ann != "Any":
+                yield Finding(
+                    rule="JL005",
+                    path=mod.path,
+                    line=a.lineno,
+                    context=qual,
+                    detail=f"{ann}-typed param {a.arg!r} in jitted signature",
+                    message=(
+                        f"jitted {target.name!r} takes {a.arg!r}: {ann} — "
+                        "every distinct key set / leaf shape is a retrace. "
+                        "Bucketed batches should be deliberate (baseline "
+                        "this) and config should not be traced at all."
+                    ),
+                )
+            if a.arg in _CONFIG_PARAM_NAMES:
+                yield Finding(
+                    rule="JL005",
+                    path=mod.path,
+                    line=a.lineno,
+                    context=qual,
+                    detail=f"config param {a.arg!r} in jitted signature",
+                    message=(
+                        f"jitted {target.name!r} threads config object "
+                        f"{a.arg!r} through the traced signature: close "
+                        "over it (or pass a hashable static) instead."
+                    ),
+                )
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func)
+        is_jit = callee == "jax.jit" or (
+            callee in ("functools.partial", "partial")
+            and node.args
+            and _dotted(node.args[0]) == "jax.jit"
+        )
+        if not is_jit or not mod.enclosing_loops(node):
+            continue
+        fn = mod.enclosing_function(node)
+        yield Finding(
+            rule="JL005",
+            path=mod.path,
+            line=node.lineno,
+            context=mod.qualname(fn or mod.tree),
+            detail="jax.jit inside loop body",
+            message=(
+                "jax.jit applied inside a loop: each iteration builds a "
+                "fresh wrapper (and usually a fresh closure) — trace + "
+                "compile every pass. Hoist the jit out of the loop."
+            ),
+        )
+
+
+# ---------------------------------------------------------------------------
+# JL006 — PRNG key reuse
+# ---------------------------------------------------------------------------
+
+
+def _is_key_producer(value: ast.AST) -> bool:
+    return isinstance(value, ast.Call) and _dotted(value.func) in (
+        "jax.random.PRNGKey", "jax.random.key", *_RNG_DERIVERS
+    )
+
+
+def rule_jl006(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL006: PRNG key reuse — the same key consumed by more than one
+    draw without an intervening ``jax.random.split``/``fold_in``: (a) one
+    key passed to two consumer calls (or twice within one call); (b) a key
+    defined outside a loop and consumed inside it without per-iteration
+    reassignment; (c) ``jax.random.PRNGKey(<constant>)`` created inside a
+    traced context — the same stream on every call, compiled in.
+
+    Reused keys give perfectly correlated "random" draws: dropout masks
+    identical across layers/steps, initializations that alias, silently
+    degraded training.
+    """
+    # (c) constant PRNGKey in traced context
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call) and _dotted(node.func) in (
+            "jax.random.PRNGKey", "jax.random.key"
+        ):
+            if node.args and isinstance(node.args[0], ast.Constant) and \
+                    mod.is_in_traced_context(node):
+                fn = mod.enclosing_function(node)
+                yield Finding(
+                    rule="JL006",
+                    path=mod.path,
+                    line=node.lineno,
+                    context=mod.qualname(fn or mod.tree),
+                    detail=f"constant PRNGKey({node.args[0].value!r}) in "
+                           "traced context",
+                    message=(
+                        "jax.random.PRNGKey with a constant seed inside a "
+                        "traced function: every call replays the identical "
+                        "stream (it is baked into the compiled program) — "
+                        "thread a key argument in instead."
+                    ),
+                )
+
+    for fn in mod.functions:
+        keys: Set[str] = set()
+        for a in list(fn.args.args) + list(fn.args.kwonlyargs):
+            n = a.arg
+            if n in ("rng", "key", "prng", "prng_key") or \
+                    n.endswith(("_rng", "_key")):
+                keys.add(n)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _is_key_producer(node.value):
+                for t in node.targets:
+                    for nm in ast.walk(t):
+                        if isinstance(nm, ast.Name):
+                            keys.add(nm.id)
+        if not keys:
+            continue
+
+        events: List[Tuple[int, str, str, ast.AST]] = []  # (line, kind, key, node)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for nm in ast.walk(t):
+                        if isinstance(nm, ast.Name) and nm.id in keys:
+                            events.append((node.lineno, "assign", nm.id, node))
+            elif isinstance(node, ast.Call):
+                callee = _dotted(node.func)
+                if callee in _RNG_DERIVERS or callee in (
+                    "jax.random.PRNGKey", "jax.random.key"
+                ):
+                    continue
+                consumed: List[str] = []
+                slots = list(node.args) + [k.value for k in node.keywords]
+                # flax .init/.apply fold the collection name into the key,
+                # so {"params": rng, "dropout": rng} is safe idiom there —
+                # don't count dict values for those callees
+                flax_entry = callee.endswith((".init", ".apply"))
+                for arg in slots:
+                    if isinstance(arg, ast.Name) and arg.id in keys:
+                        consumed.append(arg.id)
+                    elif isinstance(arg, ast.Dict) and not flax_entry:
+                        for v in arg.values:  # rngs={"dropout": rng}
+                            if isinstance(v, ast.Name) and v.id in keys:
+                                consumed.append(v.id)
+                for k in consumed:
+                    events.append((node.lineno, "consume", k, node))
+                for k in set(consumed):
+                    if consumed.count(k) > 1:
+                        events.append((node.lineno, "dup", k, node))
+
+        events.sort(key=lambda e: e[0])
+        qual = mod.qualname(fn)
+        live: Dict[str, int] = {}
+        reported: Set[str] = set()
+        for line, kind, k, node in events:
+            if kind == "assign":
+                live[k] = 0
+            elif kind == "dup" and f"dup:{k}" not in reported:
+                reported.add(f"dup:{k}")
+                yield Finding(
+                    rule="JL006", path=mod.path, line=line, context=qual,
+                    detail=f"key {k!r} passed twice in one call",
+                    message=(
+                        f"PRNG key {k!r} appears twice in a single call in "
+                        f"{qual}: both consumers draw the identical stream "
+                        "— jax.random.split it first."
+                    ),
+                )
+            elif kind == "consume":
+                loops = mod.enclosing_loops(node)
+                in_unrefreshed_loop = False
+                for loop in loops:
+                    reassigned = any(
+                        isinstance(n, ast.Assign)
+                        and any(
+                            isinstance(t, ast.Name) and t.id == k
+                            or (
+                                isinstance(t, (ast.Tuple, ast.List))
+                                and any(
+                                    isinstance(e, ast.Name) and e.id == k
+                                    for e in t.elts
+                                )
+                            )
+                            for t in n.targets
+                        )
+                        for n in ast.walk(loop)
+                    )
+                    defined_outside = not (
+                        loop.lineno <= _first_def_line(fn, k, events)
+                        <= _last_line(loop)
+                    )
+                    if not reassigned and defined_outside:
+                        in_unrefreshed_loop = True
+                        break
+                if in_unrefreshed_loop and f"loop:{k}" not in reported:
+                    reported.add(f"loop:{k}")
+                    yield Finding(
+                        rule="JL006", path=mod.path, line=line, context=qual,
+                        detail=f"key {k!r} consumed every loop iteration",
+                        message=(
+                            f"PRNG key {k!r} is consumed inside a loop in "
+                            f"{qual} without per-iteration splitting: every "
+                            "iteration draws the identical stream (unless "
+                            "the consumer folds in a counter — if it does, "
+                            "baseline or suppress this)."
+                        ),
+                    )
+                elif not in_unrefreshed_loop:
+                    count = live.get(k, 0)  # params start live at 0 uses
+                    live[k] = count + 1
+                    if count + 1 == 2 and f"multi:{k}" not in reported:
+                        reported.add(f"multi:{k}")
+                        yield Finding(
+                            rule="JL006", path=mod.path, line=line,
+                            context=qual,
+                            detail=f"key {k!r} reused by a second consumer",
+                            message=(
+                                f"PRNG key {k!r} reaches a second consumer "
+                                f"in {qual} without jax.random.split: both "
+                                "draws are identical."
+                            ),
+                        )
+
+
+def _first_def_line(fn: ast.FunctionDef, key: str, events) -> int:
+    for line, kind, k, _ in events:
+        if kind == "assign" and k == key:
+            return line
+    return fn.lineno  # parameter
+
+
+def _last_line(node: ast.AST) -> int:
+    return max(
+        (getattr(n, "lineno", 0) for n in ast.walk(node)), default=node.lineno
+    )
+
+
+RULES = {
+    "JL001": rule_jl001,
+    "JL002": rule_jl002,
+    "JL003": rule_jl003,
+    "JL004": rule_jl004,
+    "JL005": rule_jl005,
+    "JL006": rule_jl006,
+}

@@ -16,6 +16,7 @@ from typing import Optional
 import flax.linen as nn
 import jax.numpy as jnp
 
+from speakingstyle_tpu.analysis import contracts
 from speakingstyle_tpu.configs.config import Config
 from speakingstyle_tpu.models.postnet import PostNet
 from speakingstyle_tpu.models.variance_adaptor import VarianceAdaptor
@@ -56,6 +57,17 @@ class FastSpeech2(nn.Module):
         n_position = self.n_position or (cfg.max_seq_len + 1)
 
         B, L_src = texts.shape
+        contracts.assert_rank(texts, 2, "FastSpeech2.texts")
+        contracts.assert_dtype(texts, "integer", "FastSpeech2.texts")
+        contracts.assert_shape(speakers, (B,), "FastSpeech2.speakers")
+        contracts.assert_shape(src_lens, (B,), "FastSpeech2.src_lens")
+        contracts.assert_dtype(src_lens, "integer", "FastSpeech2.src_lens")
+        contracts.assert_shape(
+            mels,
+            (B, None, self.config.preprocess.preprocessing.mel.n_mel_channels),
+            "FastSpeech2.mels",
+        )
+        contracts.assert_shape(mel_lens, (B,), "FastSpeech2.mel_lens")
         src_pad_mask = length_to_mask(src_lens, L_src)
         mel_pad_mask = (
             length_to_mask(mel_lens, mels.shape[1]) if mel_lens is not None else None

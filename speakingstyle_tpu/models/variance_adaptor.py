@@ -16,6 +16,7 @@ import flax.linen as nn
 import jax.numpy as jnp
 import numpy as np
 
+from speakingstyle_tpu.analysis import contracts
 from speakingstyle_tpu.models.layers import FiLM, LN_EPS
 from speakingstyle_tpu.ops.dropout import Dropout
 from speakingstyle_tpu.ops.length_regulator import length_regulate, predicted_durations
@@ -102,6 +103,16 @@ class VarianceAdaptor(nn.Module):
         betas=None,
         deterministic: bool = True,
     ):
+        contracts.assert_rank(x, 3, "VarianceAdaptor.x")
+        contracts.assert_shape(
+            src_pad_mask, x.shape[:2], "VarianceAdaptor.src_pad_mask"
+        )
+        contracts.assert_dtype(
+            src_pad_mask, "bool", "VarianceAdaptor.src_pad_mask"
+        )
+        contracts.assert_shape(
+            duration_target, x.shape[:2], "VarianceAdaptor.duration_target"
+        )
         mk_pred = lambda name: VariancePredictor(
             self.filter_size, self.kernel_size, self.dropout,
             conv_impl=self.conv_impl, dtype=self.dtype,

@@ -49,6 +49,15 @@ def _fmix32(h):
 
 def keep_mask(rng, rate: float, shape, impl: str = "bernoulli"):
     """Boolean keep mask with P(True) = 1 - rate."""
+    if impl not in DROPOUT_IMPLS:
+        raise ValueError(
+            f"dropout impl must be one of {DROPOUT_IMPLS}, got {impl!r}"
+        )
+    if rate >= 1.0:
+        # drop everything, exactly: the bits16/hash thresholds clamp at
+        # 0xFFFF/0xFFFFFFFF and would otherwise keep a ~2^-16/2^-32 sliver
+        # of elements (which dropout() would then scale by 1/(1-rate) = inf)
+        return jnp.zeros(shape, jnp.bool_)
     if impl == "bernoulli":
         return jax.random.bernoulli(rng, 1.0 - rate, shape)
     n = 1
@@ -56,17 +65,17 @@ def keep_mask(rng, rate: float, shape, impl: str = "bernoulli"):
         n *= d
     if impl == "bits16":
         n32 = (n + 1) // 2
-        bits32 = jax.random.bits(rng, (n32,), jnp.uint32)
+        # the three rng consumers live in mutually exclusive impl branches
+        # — exactly one draw happens per call
+        bits32 = jax.random.bits(rng, (n32,), jnp.uint32)  # jaxlint: disable=JL006
         bits16 = jax.lax.bitcast_convert_type(bits32, jnp.uint16).reshape(-1)
         thresh = min(0xFFFF, int(round(rate * 65536)))
         return (bits16[:n] >= jnp.uint16(thresh)).reshape(shape)
-    if impl == "hash":
-        salt = jax.random.bits(rng, (), jnp.uint32)
-        idx = jax.lax.iota(jnp.uint32, n)
-        h = _fmix32((idx * _u32(0x9E3779B9)) ^ salt)
-        thresh = min(0xFFFFFFFF, int(round(rate * 2**32)))
-        return (h >= _u32(thresh)).reshape(shape)
-    raise ValueError(f"dropout impl must be one of {DROPOUT_IMPLS}, got {impl!r}")
+    salt = jax.random.bits(rng, (), jnp.uint32)
+    idx = jax.lax.iota(jnp.uint32, n)
+    h = _fmix32((idx * _u32(0x9E3779B9)) ^ salt)
+    thresh = min(0xFFFFFFFF, int(round(rate * 2**32)))
+    return (h >= _u32(thresh)).reshape(shape)
 
 
 def dropout(x, rate: float, rng, impl: str = "bernoulli"):
@@ -76,9 +85,8 @@ def dropout(x, rate: float, rng, impl: str = "bernoulli"):
     if rate == 0.0:
         return x
     if rate >= 1.0:
-        # nn.Dropout semantics: drop everything, exactly. (The threshold
-        # impls would otherwise keep a ~2^-16/2^-32 sliver of elements and
-        # scale them by 1/(1-rate) = inf.)
+        # nn.Dropout semantics: drop everything, exactly (keep_mask also
+        # guards this case; returning here just skips the dead where())
         return jnp.zeros_like(x)
     mask = keep_mask(rng, rate, x.shape, impl)
     return jnp.where(mask, x / (1.0 - rate), jnp.zeros_like(x))

@@ -16,6 +16,7 @@ single most important TPU-side design change (SURVEY.md §7 step 4).
 import jax
 import jax.numpy as jnp
 
+from speakingstyle_tpu.analysis import contracts
 from speakingstyle_tpu.ops.masking import length_to_mask
 
 
@@ -30,6 +31,10 @@ def length_regulate(x, durations, max_mel_len):
     Returns:
       (frames [B, max_mel_len, H], mel_lens [B], mel_pad_mask [B, max_mel_len])
     """
+    contracts.assert_rank(x, 3, "length_regulate.x")
+    contracts.assert_shape(
+        durations, x.shape[:2], "length_regulate.durations"
+    )
     durations = durations.astype(jnp.int32)
     ends = jnp.cumsum(durations, axis=1)  # [B, L_src]
     mel_lens = ends[:, -1]

@@ -37,6 +37,7 @@ eliminated.
 """
 
 import functools
+import inspect
 from typing import Optional
 
 import jax
@@ -202,7 +203,23 @@ def _batch_partitioned(fn, rule: str):
             out_sh = _batch_only(mesh, b, (result_infos,))[0]
         return mesh, fn, out_sh, arg_sh
 
-    cp.def_partition(partition=partition, sharding_rule=rule)
+    def infer_sharding(mesh, arg_infos, result_infos):
+        b = _batch_axis(mesh, arg_infos)
+        if isinstance(result_infos, (list, tuple)):
+            return _batch_only(mesh, b, result_infos)
+        return _batch_only(mesh, b, (result_infos,))[0]
+
+    # ``sharding_rule`` (a Shardy einsum rule) exists from jax 0.4.(late)/0.5
+    # onward; older releases take the GSPMD ``infer_sharding_from_operands``
+    # callback instead — same batch-only policy either way.
+    if "sharding_rule" in inspect.signature(
+        custom_partitioning.def_partition
+    ).parameters:
+        cp.def_partition(partition=partition, sharding_rule=rule)
+    else:
+        cp.def_partition(
+            partition=partition, infer_sharding_from_operands=infer_sharding
+        )
     return cp
 
 

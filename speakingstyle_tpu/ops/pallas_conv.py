@@ -327,7 +327,22 @@ def _fused_bwd(dilation, relu, tile, interpret, bwd_mode, res, g):
         d_scale = d_lnbias = None
         da = gf
     if relu:
-        da = da * (act > 0)
+        # ReLU mask from the residual stored in x.dtype. The threshold is
+        # the stored dtype's smallest positive NORMAL (finfo.tiny), not a
+        # literal 0: accumulator values that round to a stored 0 or
+        # subnormal (possible in bf16, where recompute mode would keep
+        # their gradient) are cut off at a bound that is explicit in the
+        # stored dtype rather than implicit in its rounding — and XLA
+        # flushes subnormals to zero anyway, so a subnormal threshold
+        # constant would itself collapse to 0 (observed on CPU). Every
+        # normal positive stored value passes, so f32 parity with the old
+        # ``act > 0`` mask is exact; see the bf16 parity test for the
+        # low-precision tolerance note.
+        if jnp.issubdtype(act.dtype, jnp.floating):
+            relu_thresh = float(jnp.finfo(act.dtype).tiny)
+            da = da * (act.astype(jnp.float32) >= relu_thresh)
+        else:
+            da = da * (act > 0)
     dz = da.astype(x.dtype)
     db = None if bias is None else da.sum(axis=(0, 1)).astype(bias.dtype)
 

@@ -17,6 +17,7 @@ import jax
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from speakingstyle_tpu.analysis import contracts
 from speakingstyle_tpu.configs.config import Config
 from speakingstyle_tpu.models.loss import fastspeech2_loss
 from speakingstyle_tpu.training.state import TrainState
@@ -53,6 +54,16 @@ def make_train_step(model, tx, cfg: Config, mesh=None, state_shardings=None):
     e_level = cfg.preprocess.preprocessing.energy.feature
 
     def step_fn(state: TrainState, arrays: Dict, rng) -> tuple:
+        # trace-time contracts: shape/dtype metadata only, so these run
+        # (and fail) during tracing and add nothing to the compiled step
+        B = arrays["texts"].shape[0]
+        contracts.assert_rank(arrays["texts"], 2, "train_step.texts")
+        contracts.assert_rank(arrays["mels"], 3, "train_step.mels")
+        contracts.assert_shape(arrays["src_lens"], (B,), "train_step.src_lens")
+        contracts.assert_shape(arrays["mel_lens"], (B,), "train_step.mel_lens")
+        contracts.assert_shape(
+            arrays["durations"], arrays["texts"].shape, "train_step.durations"
+        )
         rng = jax.random.fold_in(rng, state.step)
 
         def loss_fn(params):
@@ -297,7 +308,9 @@ def run_training(
             ):
                 jax.profiler.start_trace(profile_dir)
                 trace_active = True
-            state, losses = train_step(state, arrays, step_rng)
+            # step_fn folds state.step into the key, so passing the same
+            # step_rng every iteration yields a fresh per-step stream
+            state, losses = train_step(state, arrays, step_rng)  # jaxlint: disable=JL006
             step += 1
             window_frames += int(batch.mel_lens.sum())  # host-side, no sync
             if trace_active and step - start_step >= profile_steps[1]:
@@ -307,6 +320,8 @@ def run_training(
 
             if logger and step % steps.log_step == 0:
                 jax.block_until_ready(losses["total_loss"])
+                # host boundary: losses are materialized for logging anyway
+                contracts.assert_tree_finite(losses, "train_step.losses")
                 lr = float(schedule(jnp.asarray(step - 1)))
                 logger.log(step, {k: float(v) for k, v in losses.items()}, lr=lr)
                 dt = time.perf_counter() - window_t0

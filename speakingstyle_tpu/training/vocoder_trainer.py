@@ -249,23 +249,24 @@ def save_vocoder(path: str, state: VocoderState):
 def restore_vocoder(path: str, state: VocoderState) -> VocoderState:
     """Restore a full GAN state checkpoint into ``state``'s structure.
 
-    Tolerant of structure drift: checkpoints saved before the r4
-    spectral-norm addition lack ``msd_stats`` (and their first MSD scale's
-    param subtree differs). Any top-level field whose saved structure no
-    longer matches is kept at its freshly-initialized value, with a
-    warning — everything that does match is restored."""
+    Tolerant of exactly ONE kind of structure drift: checkpoints saved
+    before the r4 spectral-norm addition, recognized by ``msd_stats``
+    being absent from the raw msgpack dict (their first MSD scale's param
+    subtree also differs). For those, the MSD-side fields fall back to
+    their freshly-initialized values with a warning naming each failed
+    field and its underlying error. Any other structural mismatch (e.g. a
+    checkpoint from a different discriminator topology) is a hard error —
+    silently training a fresh discriminator against a restored generator
+    under a restored step counter would masquerade as a resume."""
     with open(path, "rb") as f:
         data = f.read()
     try:
         return serialization.from_bytes(state, data)
     except (ValueError, KeyError):
         raw = serialization.msgpack_restore(data)
-        # ONLY the fields the r4 spectral-norm change touched may fall back
-        # to fresh values; a generator/optimizer/step mismatch means the
-        # checkpoint is from an incompatible run and must be a hard error
-        # (silently training fresh weights under a restored step counter
-        # would masquerade as a resume).
-        tolerated = {"msd_stats", "msd_params", "disc_opt"}
+        # the actual pre-r4 signature, not just "something didn't match"
+        pre_r4 = "msd_stats" not in raw
+        tolerated = {"msd_stats", "msd_params", "disc_opt"} if pre_r4 else set()
         restored, kept_fresh = {}, []
         for name in state._fields:
             fresh = getattr(state, name)
@@ -273,15 +274,26 @@ def restore_vocoder(path: str, state: VocoderState) -> VocoderState:
                 restored[name] = serialization.from_state_dict(
                     fresh, raw[name]
                 )
-            except (ValueError, KeyError):
+            except (ValueError, KeyError) as e:
                 if name not in tolerated:
-                    raise
+                    raise ValueError(
+                        f"checkpoint {path} does not match the current "
+                        f"VocoderState layout: field {name!r} failed to "
+                        f"restore ({type(e).__name__}: {e}). This is not a "
+                        "pre-r4 checkpoint (msd_stats "
+                        f"{'missing' if pre_r4 else 'present'}), so no "
+                        "tolerant fallback applies."
+                    ) from e
                 restored[name] = fresh
-                kept_fresh.append(name)
+                kept_fresh.append((name, f"{type(e).__name__}: {e}"))
+        for name, err in kept_fresh:
+            print(
+                f"[restore_vocoder] {path}: field {name!r} kept "
+                f"freshly-initialized ({err})"
+            )
         print(
-            f"[restore_vocoder] checkpoint {path} predates the current "
-            f"state layout; kept freshly-initialized: {kept_fresh} "
-            "(pre-r4 checkpoints lack the MSD spectral-norm state)"
+            f"[restore_vocoder] checkpoint {path} predates the r4 MSD "
+            f"spectral-norm state; kept fresh: {[n for n, _ in kept_fresh]}"
         )
         return VocoderState(**restored)
 
@@ -319,9 +331,12 @@ def train_vocoder(
     train_step = make_vocoder_train_step(
         cfg, hp, gen, mpd, msd, gen_tx, disc_tx, mesh=mesh
     )
+    # fold the restored step into the dataset seed: a resumed run draws a
+    # fresh batch/segment stream instead of replaying the original run's
+    # sequence from its beginning
     ds = MelWavDataset(
         wav_paths, cfg, segment_size=hp.segment_size, batch_size=batch_size,
-        fine_tune_mel_dir=fine_tune_mel_dir, seed=seed,
+        fine_tune_mel_dir=fine_tune_mel_dir, seed=seed + int(state.step),
     )
     step = int(state.step)
     metrics = {}

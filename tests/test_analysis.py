@@ -1,0 +1,528 @@
+"""jaxlint + runtime-contract tests (tier-1 regression gate).
+
+Three layers:
+  1. fixture tests — every JL rule has positive (fires) and negative
+     (stays silent) snippets, linted in-memory via ``lint_source``;
+  2. suppression + baseline mechanics — inline disables, skip-file, and
+     the bidirectional baseline compare;
+  3. the real gate — the package is clean modulo the committed baseline
+     (fails loudly when either the code or the baseline drifts), and the
+     CLI exit codes match the contract in ``scripts/lint_jax.py``.
+"""
+
+import textwrap
+
+import numpy as np
+import pytest
+
+from speakingstyle_tpu.analysis import cli, contracts, linter
+
+
+def _codes(source, path="speakingstyle_tpu/fake.py"):
+    return sorted({f.rule for f in linter.lint_source(
+        textwrap.dedent(source), path
+    )})
+
+
+# ---------------------------------------------------------------------------
+# JL001 — trace-unsafe control flow
+# ---------------------------------------------------------------------------
+
+
+def test_jl001_positive_if_on_traced_param():
+    assert "JL001" in _codes("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """)
+
+
+def test_jl001_positive_nn_module_call():
+    assert "JL001" in _codes("""
+        import flax.linen as nn
+
+        class Layer(nn.Module):
+            def __call__(self, x):
+                while x < 0:
+                    x = x + 1
+                return x
+    """)
+
+
+def test_jl001_negative_shape_branch_and_untraced():
+    # metadata branches and plain functions are trace-safe
+    assert "JL001" not in _codes("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x.shape[0] > 2:
+                return x[:2]
+            return x
+
+        def g(x):
+            if x > 0:
+                return x
+            return -x
+    """)
+
+
+# ---------------------------------------------------------------------------
+# JL002 — numpy on jax arrays
+# ---------------------------------------------------------------------------
+
+_JL002_SRC = """
+    import numpy as np
+    import jax.numpy as jnp
+
+    def f():
+        y = jnp.ones((3,))
+        return np.sum(y)
+"""
+
+
+def test_jl002_positive_np_on_jax_array():
+    assert "JL002" in _codes(_JL002_SRC)
+
+
+def test_jl002_negative_tests_are_exempt():
+    assert _codes(_JL002_SRC, path="tests/test_fake.py") == []
+
+
+def test_jl002_negative_np_on_host_data():
+    assert "JL002" not in _codes("""
+        import numpy as np
+        import jax.numpy as jnp
+
+        def f(host_list):
+            y = jnp.ones((3,))
+            z = jnp.sum(y)
+            return np.sum(host_list), z
+    """)
+
+
+# ---------------------------------------------------------------------------
+# JL003 — donation / static hashability
+# ---------------------------------------------------------------------------
+
+
+def test_jl003_positive_missing_donation():
+    assert "JL003" in _codes("""
+        import jax
+
+        def step(state, batch):
+            new_state = state.replace(step=state.step + 1)
+            return new_state
+
+        step = jax.jit(step)
+    """)
+
+
+def test_jl003_negative_donated():
+    assert "JL003" not in _codes("""
+        import jax
+
+        def step(state, batch):
+            new_state = state.replace(step=state.step + 1)
+            return new_state
+
+        step = jax.jit(step, donate_argnums=(0,))
+    """)
+
+
+def test_jl003_positive_unhashable_static():
+    assert "JL003" in _codes("""
+        import jax
+
+        def f(x, shapes):
+            return x
+
+        g = jax.jit(f, static_argnums=(1,))
+
+        def run(x):
+            return g(x, [1, 2])
+    """)
+
+
+# ---------------------------------------------------------------------------
+# JL004 — host sync in training loops
+# ---------------------------------------------------------------------------
+
+_JL004_SRC = """
+    def loop(batches):
+        total = 0.0
+        for b in batches:
+            total += b.loss.item()
+        return total
+"""
+
+
+def test_jl004_positive_item_in_training_loop():
+    assert "JL004" in _codes(
+        _JL004_SRC, path="speakingstyle_tpu/training/fake.py"
+    )
+
+
+def test_jl004_negative_outside_training():
+    # same pattern outside training/ is out of scope for this rule
+    assert "JL004" not in _codes(
+        _JL004_SRC, path="speakingstyle_tpu/ops/fake.py"
+    )
+
+
+def test_jl004_negative_sync_outside_loop():
+    assert "JL004" not in _codes("""
+        def summarize(final_loss):
+            return float(final_loss)
+    """, path="speakingstyle_tpu/training/fake.py")
+
+
+# ---------------------------------------------------------------------------
+# JL005 — recompilation hazards
+# ---------------------------------------------------------------------------
+
+
+def test_jl005_positive_config_in_signature():
+    assert "JL005" in _codes("""
+        import jax
+
+        @jax.jit
+        def f(x, cfg):
+            return x * cfg.scale
+    """)
+
+
+def test_jl005_positive_dict_param_and_scalar_default():
+    codes = linter.lint_source(textwrap.dedent("""
+        import jax
+        from typing import Dict
+
+        def f(batch: Dict, scale: float = 1.0):
+            return batch
+
+        g = jax.jit(f)
+    """), "speakingstyle_tpu/fake.py")
+    details = {c.detail for c in codes if c.rule == "JL005"}
+    assert any("Dict-typed" in d for d in details)
+    assert any("scalar param" in d for d in details)
+
+
+def test_jl005_positive_jit_in_loop():
+    assert "JL005" in _codes("""
+        import jax
+
+        def main(fns):
+            outs = []
+            for f in fns:
+                outs.append(jax.jit(f))
+            return outs
+    """)
+
+
+def test_jl005_negative_static_config():
+    assert "JL005" not in _codes("""
+        import jax
+        import functools
+
+        @functools.partial(jax.jit, static_argnames=("cfg",))
+        def f(x, cfg):
+            return x * cfg.scale
+    """)
+
+
+# ---------------------------------------------------------------------------
+# JL006 — PRNG key reuse
+# ---------------------------------------------------------------------------
+
+
+def test_jl006_positive_key_reuse():
+    assert "JL006" in _codes("""
+        import jax
+
+        def f(rng):
+            a = jax.random.normal(rng, (2,))
+            b = jax.random.normal(rng, (2,))
+            return a + b
+    """)
+
+
+def test_jl006_positive_key_in_loop():
+    assert "JL006" in _codes("""
+        import jax
+
+        def f(rng, n):
+            out = 0.0
+            for _ in range(n):
+                out = out + jax.random.normal(rng, (2,))
+            return out
+    """)
+
+
+def test_jl006_positive_constant_key_in_traced_context():
+    assert "JL006" in _codes("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            k = jax.random.PRNGKey(0)
+            return x + jax.random.normal(k, x.shape)
+    """)
+
+
+def test_jl006_negative_split_before_use():
+    assert "JL006" not in _codes("""
+        import jax
+
+        def f(rng):
+            k1, k2 = jax.random.split(rng)
+            a = jax.random.normal(k1, (2,))
+            b = jax.random.normal(k2, (2,))
+            return a + b
+    """)
+
+
+def test_jl006_negative_flax_rngs_dict_idiom():
+    # .init/.apply fold the collection name into the key: not reuse
+    assert "JL006" not in _codes("""
+        def f(model, rng, x):
+            return model.init({"params": rng, "dropout": rng}, x)
+    """)
+
+
+# ---------------------------------------------------------------------------
+# suppressions
+# ---------------------------------------------------------------------------
+
+_SUPPRESSIBLE = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        if x > 0:{comment}
+            return x
+        return -x
+"""
+
+
+def test_inline_disable_specific_rule():
+    src = _SUPPRESSIBLE.format(comment="  # jaxlint: disable=JL001")
+    assert "JL001" not in _codes(src)
+
+
+def test_inline_disable_bare():
+    src = _SUPPRESSIBLE.format(comment="  # jaxlint: disable")
+    assert "JL001" not in _codes(src)
+
+
+def test_inline_disable_other_rule_does_not_apply():
+    src = _SUPPRESSIBLE.format(comment="  # jaxlint: disable=JL004")
+    assert "JL001" in _codes(src)
+
+
+def test_skip_file_directive():
+    src = "# jaxlint: skip-file\n" + textwrap.dedent(
+        _SUPPRESSIBLE.format(comment="")
+    )
+    assert linter.lint_source(src, "speakingstyle_tpu/fake.py") == []
+
+
+def test_directive_in_string_literal_is_ignored():
+    src = 's = "# jaxlint: skip-file"\n' + textwrap.dedent(
+        _SUPPRESSIBLE.format(comment="")
+    )
+    assert "JL001" in {
+        f.rule for f in linter.lint_source(src, "speakingstyle_tpu/fake.py")
+    }
+
+
+# ---------------------------------------------------------------------------
+# baseline mechanics + the real gate
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_compare_is_bidirectional():
+    findings = linter.lint_source(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """), "speakingstyle_tpu/fake.py")
+    assert findings
+    empty = linter.findings_counter([])
+    new, stale = linter.compare_to_baseline(findings, empty)
+    assert new and not stale
+    new, stale = linter.compare_to_baseline(
+        [], linter.findings_counter(findings)
+    )
+    assert stale and not new
+
+
+def test_baseline_roundtrip(tmp_path):
+    findings = linter.lint_source(
+        "import jax\n\n@jax.jit\ndef f(x):\n    if x > 0:\n        return x"
+        "\n    return -x\n",
+        "speakingstyle_tpu/fake.py",
+    )
+    path = str(tmp_path / "baseline.json")
+    linter.save_baseline(findings, path)
+    loaded = linter.load_baseline(path)
+    new, stale = linter.compare_to_baseline(findings, loaded)
+    assert not new and not stale
+
+
+def test_repo_is_clean_modulo_committed_baseline():
+    """THE tier-1 gate: the tree must match analysis/baseline.json exactly.
+
+    New findings => fix them or (if deliberate) run
+    `python scripts/lint_jax.py --update-baseline` and commit the diff.
+    Stale entries => the hazard was fixed; update the baseline so it
+    cannot mask a future regression at the same fingerprint.
+    """
+    findings = linter.lint_paths()
+    baseline = linter.load_baseline()
+    assert baseline, "committed baseline is missing or empty"
+    new, stale = linter.compare_to_baseline(findings, baseline)
+    assert not new, (
+        "new jaxlint findings over the committed baseline "
+        f"(run scripts/lint_jax.py to see them): {sorted(new)}"
+    )
+    assert not stale, (
+        "stale baseline entries (fixed in code, still listed — run "
+        f"scripts/lint_jax.py --update-baseline): {sorted(stale)}"
+    )
+
+
+def test_every_rule_is_non_vacuous():
+    """Each JL rule has at least one true finding in the tree (possibly
+    baselined) — rules that never fire are dead weight."""
+    fired = {f.rule for f in linter.lint_paths()}
+    fired |= {fp.split(":", 1)[0] for fp in linter.load_baseline()}
+    for code in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006"):
+        assert code in fired, f"{code} never fires on the real tree"
+
+
+def test_cli_check_exits_zero_on_repo():
+    assert cli.main(["--check"]) == 0
+
+
+@pytest.mark.parametrize("code,src", [
+    ("JL001", "import jax\n\n@jax.jit\ndef f(x):\n    if x > 0:\n"
+              "        return x\n    return -x\n"),
+    ("JL002", "import numpy as np\nimport jax.numpy as jnp\n\ndef f():\n"
+              "    y = jnp.ones((3,))\n    return np.sum(y)\n"),
+    ("JL003", "import jax\n\ndef step(state, b):\n"
+              "    new_state = state.replace(step=state.step + 1)\n"
+              "    return new_state\n\nstep = jax.jit(step)\n"),
+    ("JL004", "def loop(bs):\n    t = 0.0\n    for b in bs:\n"
+              "        t += b.loss.item()\n    return t\n"),
+    ("JL005", "import jax\n\n@jax.jit\ndef f(x, cfg):\n"
+              "    return x * cfg.scale\n"),
+    ("JL006", "import jax\n\ndef f(rng):\n"
+              "    a = jax.random.normal(rng, (2,))\n"
+              "    b = jax.random.normal(rng, (2,))\n    return a + b\n"),
+])
+def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
+    # JL004 is scoped to training/ paths
+    d = tmp_path / "training"
+    d.mkdir()
+    f = d / "fixture.py"
+    f.write_text(src)
+    rc = cli.main([str(f), "--no-baseline", "--check", "--select", code])
+    assert rc == 1, f"{code} positive fixture did not fail the CLI"
+
+
+def test_cli_rejects_unknown_rule():
+    assert cli.main(["--select", "JL999"]) == 2
+
+
+def test_cli_list_rules():
+    assert cli.main(["--list-rules"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# runtime contracts
+# ---------------------------------------------------------------------------
+
+
+def test_contracts_noop_when_disabled(monkeypatch):
+    monkeypatch.setattr(contracts, "ENABLED", False)
+    x = np.zeros((2, 3))
+    assert contracts.assert_shape(x, (99, 99), "x") is x
+    assert contracts.assert_rank(x, 7, "x") is x
+    assert contracts.assert_dtype(x, "integer", "x") is x
+    assert contracts.assert_tree_finite(
+        {"a": np.array([np.nan])}, "t"
+    ) is not None
+
+
+def test_contracts_enabled(monkeypatch):
+    monkeypatch.setattr(contracts, "ENABLED", True)
+    x = np.zeros((2, 3), np.float32)
+    # passing specs return the array through
+    assert contracts.assert_shape(x, (2, 3), "x") is x
+    assert contracts.assert_shape(x, (None, 3), "x") is x
+    assert contracts.assert_rank(x, 2, "x") is x
+    assert contracts.assert_dtype(x, "floating", "x") is x
+    assert contracts.assert_shape(None, (1,), "optional") is None
+    with pytest.raises(contracts.ContractError):
+        contracts.assert_shape(x, (2, 4), "x")
+    with pytest.raises(contracts.ContractError):
+        contracts.assert_rank(x, 3, "x")
+    with pytest.raises(contracts.ContractError):
+        contracts.assert_dtype(x, "integer", "x")
+    with pytest.raises(contracts.ContractError):
+        contracts.assert_tree_finite({"a": np.array([1.0, np.nan])}, "t")
+    contracts.assert_tree_finite({"a": np.array([1.0, 2.0])}, "t")
+
+
+def test_contracts_tree_finite_skips_tracers(monkeypatch):
+    monkeypatch.setattr(contracts, "ENABLED", True)
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        contracts.assert_tree_finite({"x": x}, "inside-jit")
+        return x * 2
+
+    # NaN input must NOT raise inside jit (leaves are tracers there);
+    # the check belongs at host boundaries
+    out = f(jnp.array([jnp.nan]))
+    assert np.isnan(np.asarray(out)).all()
+
+
+def test_contracts_fire_at_trace_time_in_jit(monkeypatch):
+    monkeypatch.setattr(contracts, "ENABLED", True)
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        contracts.assert_rank(x, 2, "x")
+        return x
+
+    with pytest.raises(contracts.ContractError):
+        f(jnp.zeros((3,)))  # wrong rank fails during tracing
+
+
+def test_length_regulate_contract_integration(monkeypatch):
+    monkeypatch.setattr(contracts, "ENABLED", True)
+    import jax.numpy as jnp
+
+    from speakingstyle_tpu.ops.length_regulator import length_regulate
+
+    x = jnp.zeros((2, 5, 8))
+    good = jnp.ones((2, 5), jnp.int32)
+    frames, lens, mask = length_regulate(x, good, 16)
+    assert frames.shape == (2, 16, 8)
+    with pytest.raises(contracts.ContractError):
+        length_regulate(x, jnp.ones((2, 4), jnp.int32), 16)
+    with pytest.raises(contracts.ContractError):
+        length_regulate(x[0], good, 16)  # rank-2 features

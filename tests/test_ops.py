@@ -271,6 +271,58 @@ def test_fused_conv_bwd_modes_agree():
     np.testing.assert_allclose(grads[0], grads[1], atol=1e-5)
 
 
+def test_fused_conv_bwd_modes_agree_bf16():
+    """Analytic-vs-recompute gradient parity with bf16 storage and ReLU.
+
+    Tolerance note: the analytic backward rebuilds the ReLU mask from the
+    activation residual *as stored in bf16* with a strictly-positive
+    threshold (finfo(bf16).tiny), while recompute mode re-derives it from
+    an f32 recompute. The two masks can only disagree on elements whose
+    pre-activation magnitude is below bf16's smallest normal (~1.2e-38) —
+    probability ~0 for these inputs — so the remaining difference is pure
+    bf16 rounding noise on the matching elements, bounded by the loose
+    tolerances here (bf16 has ~8 mantissa bits => ~0.4% relative)."""
+    import jax
+
+    from speakingstyle_tpu.ops.pallas_conv import fused_conv1d
+
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal((2, 16, 8)), jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((3, 8, 12)) * 0.1, jnp.bfloat16)
+    grads = [
+        np.asarray(
+            jax.grad(
+                lambda x_: jnp.sum(
+                    fused_conv1d(
+                        x_, w, None, relu=True, interpret=True, bwd_mode=m
+                    ).astype(jnp.float32) ** 2
+                )
+            )(x),
+            np.float32,
+        )
+        for m in ("analytic", "recompute")
+    ]
+    np.testing.assert_allclose(grads[0], grads[1], rtol=2e-2, atol=5e-2)
+    # the fix this guards: gradients flow wherever the STORED activation
+    # is a normal positive — analytic mode must not zero more elements
+    # than a strictly-positive stored value implies
+    y = np.asarray(
+        fused_conv1d(x, w, None, relu=True, interpret=True), np.float32
+    )
+    dy_analytic = np.asarray(
+        jax.grad(
+            lambda x_: jnp.sum(
+                fused_conv1d(
+                    x_, w, None, relu=True, interpret=True,
+                    bwd_mode="analytic",
+                ).astype(jnp.float32).sum(axis=(0, 1))[0]
+            )
+        )(x),
+        np.float32,
+    )
+    assert np.any(y > 0) and np.any(dy_analytic != 0)
+
+
 def test_fused_conv_relu_ln_grads_lane_aligned():
     """Gradient parity at a lane-aligned (cout=128) width: this is the
     config where the REAL kernel path runs (the cout=16 test above trips
