@@ -18,6 +18,7 @@ JL003  missing donation on state-updating jits; unhashable static args
 JL004  host-device sync inside training loops
 JL005  recompilation hazards in jitted signatures
 JL006  PRNG key reuse without split
+JL007  swallowed exceptions (broad except with no handling)
 """
 
 import ast
@@ -977,6 +978,88 @@ def _last_line(node: ast.AST) -> int:
     )
 
 
+# ---------------------------------------------------------------------------
+# JL007 — swallowed exceptions
+# ---------------------------------------------------------------------------
+
+_BROAD_EXCEPTION_NAMES = {"Exception", "BaseException"}
+_HANDLING_CALL_MARKERS = ("print", "log", "warn", "fail", "record")
+
+
+def _handler_is_broad(handler: ast.ExceptHandler) -> Optional[str]:
+    """The broad type name this handler catches, or None if specific."""
+    t = handler.type
+    if t is None:
+        return "bare except"
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in types:
+        name = _dotted(e).split(".")[-1]
+        if name in _BROAD_EXCEPTION_NAMES:
+            return name
+    return None
+
+
+def _body_swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the handler drops the error on the floor: no raise, the
+    bound exception name (if any) is never read, and nothing that looks
+    like logging/reporting runs."""
+    for node in ast.walk(handler):
+        if node is handler:
+            continue
+        if isinstance(node, ast.Raise):
+            return False
+        if isinstance(node, ast.ExceptHandler):
+            return False  # nested try/except: too opaque to judge
+        if handler.name and isinstance(node, ast.Name) and \
+                isinstance(node.ctx, ast.Load) and node.id == handler.name:
+            return False  # the error is used (re-packaged, returned, ...)
+        if isinstance(node, ast.Call):
+            callee = _dotted(node.func).lower()
+            if any(m in callee for m in _HANDLING_CALL_MARKERS):
+                return False
+    return True
+
+
+def rule_jl007(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL007: swallowed exceptions — an ``except`` catching a broad type
+    (bare ``except:``, ``Exception``, ``BaseException``) whose body
+    neither re-raises, nor reads the bound error, nor logs: the failure
+    silently vanishes.
+
+    In a fault-tolerant training harness every swallowed exception is a
+    masked fault: a loader error eaten here bypasses the retry/quarantine
+    accounting (training/resilience.py) and surfaces later as a hang or a
+    silent data gap. Catch the narrowest type that models the expected
+    failure, or route the error through the resilience layer. Scoped to
+    the shipped package (``speakingstyle_tpu/``) — tests and one-off
+    scripts may probe-and-ignore deliberately.
+    """
+    p = mod.path.replace("\\", "/")
+    if "speakingstyle_tpu/" not in p:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        broad = _handler_is_broad(node)
+        if broad is None or not _body_swallows(node):
+            continue
+        fn = mod.enclosing_function(node)
+        qual = mod.qualname(fn or mod.tree)
+        yield Finding(
+            rule="JL007",
+            path=mod.path,
+            line=node.lineno,
+            context=qual,
+            detail=f"swallowed {broad}",
+            message=(
+                f"`except {broad}` in {qual} swallows the error (no "
+                "re-raise, no use of the exception, no logging): the "
+                "failure vanishes. Catch the narrowest expected type, or "
+                "log/route it through the resilience layer."
+            ),
+        )
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -984,4 +1067,5 @@ RULES = {
     "JL004": rule_jl004,
     "JL005": rule_jl005,
     "JL006": rule_jl006,
+    "JL007": rule_jl007,
 }

@@ -37,11 +37,23 @@ def build_parser(parser=None):
         "--profile_dir", type=str, default=None,
         help="write a jax.profiler trace of steps 10-20 here",
     )
+    parser.add_argument(
+        "--faults", type=str, default=None,
+        help="deterministic fault-injection spec for resilience drills, "
+        "e.g. 'nan_grads@120;sigterm@500' (sets SPEAKINGSTYLE_FAULTS; "
+        "see training/faults.py for the grammar)",
+    )
     return parser
 
 
 def main(args):
     import os
+
+    if args.faults:
+        from speakingstyle_tpu.training.faults import ENV_VAR, FaultPlan
+
+        FaultPlan.parse(args.faults)  # validate the spec before training
+        os.environ[ENV_VAR] = args.faults
 
     if os.environ.get("SPEAKINGSTYLE_MULTIHOST"):
         # Pod-slice training: every host runs this process; initialize()

@@ -306,12 +306,61 @@ class ShardingConfig:
 
 
 @dataclass(frozen=True)
+class ResilienceConfig:
+    """Fault-tolerance knobs (training/resilience.py — no reference
+    counterpart; the reference loop dies on the first bad sample and
+    loses up to save_step steps on preemption).
+
+    See the "Resilience" section of ARCHITECTURE.md for the fault model
+    and the ``SPEAKINGSTYLE_FAULTS`` injection spec grammar."""
+
+    # checkpoint saves run on a background thread (the step loop never
+    # blocks on Orbax I/O); the device->host snapshot is still taken
+    # synchronously so buffer donation cannot invalidate an in-flight save
+    async_checkpointing: bool = True
+    # retain the newest N step checkpoints; 0 keeps everything
+    max_to_keep: int = 5
+    # never prune the best-val-loss step, even past max_to_keep
+    keep_best: bool = True
+    # fold an all-finite reduction over losses+grads into the jitted step
+    # and check it host-side at the log boundary; on trip, roll back to
+    # the last good checkpoint with a diverged data stream
+    nan_sentinel: bool = True
+    # abort with TrainingDivergedError after this many CONSECUTIVE
+    # rollbacks (a finite check window resets the counter)
+    max_rollbacks: int = 3
+    # feature-loader retry-with-exponential-backoff on transient I/O errors
+    loader_retries: int = 3
+    loader_backoff: float = 0.05  # seconds; doubles per attempt
+    # samples that still fail after retries are quarantined (logged +
+    # skipped); the run fails only past this many distinct bad samples
+    bad_sample_budget: int = 16
+
+    def __post_init__(self):
+        if self.max_to_keep < 0:
+            raise ValueError(f"max_to_keep must be >= 0, got {self.max_to_keep}")
+        if self.max_rollbacks < 0:
+            raise ValueError(
+                f"max_rollbacks must be >= 0, got {self.max_rollbacks}"
+            )
+        if self.loader_retries < 0:
+            raise ValueError(
+                f"loader_retries must be >= 0, got {self.loader_retries}"
+            )
+        if self.bad_sample_budget < 0:
+            raise ValueError(
+                f"bad_sample_budget must be >= 0, got {self.bad_sample_budget}"
+            )
+
+
+@dataclass(frozen=True)
 class TrainConfig:
     path: TrainPathConfig = field(default_factory=TrainPathConfig)
     optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
     step: StepConfig = field(default_factory=StepConfig)
     loss: LossConfig = field(default_factory=LossConfig)
     sharding: ShardingConfig = field(default_factory=ShardingConfig)
+    resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     ignore_layers: List[str] = field(default_factory=list)
     seed: int = 1234
     # Use XLA's native RBG bit generator for dropout masks instead of

@@ -78,7 +78,14 @@ class Batch:
 
 
 class SpeechDataset:
-    """Feature-loading dataset (reference: dataset.py:12-146)."""
+    """Feature-loading dataset (reference: dataset.py:12-146).
+
+    ``retries``/``backoff`` engage retry-with-exponential-backoff on
+    transient OSErrors in the feature loads (flaky network filesystems on
+    preemptible slices); ``fault_plan`` (training/faults.py) injects a
+    ``loader_ioerror`` exactly once at the named feature-load count so the
+    retry path is exercised deterministically in tests.
+    """
 
     def __init__(
         self,
@@ -86,6 +93,9 @@ class SpeechDataset:
         config: Config,
         sort: bool = True,
         drop_last: bool = False,
+        retries: int = 0,
+        backoff: float = 0.05,
+        fault_plan=None,
     ):
         pp = config.preprocess
         self.root = pp.path.preprocessed_path
@@ -96,6 +106,10 @@ class SpeechDataset:
         self.drop_last = drop_last
         self.pitch_level = pp.preprocessing.pitch.feature
         self.energy_level = pp.preprocessing.energy.feature
+        self.retries = retries
+        self.backoff = backoff
+        self.fault_plan = fault_plan
+        self._feature_loads = 0  # loader_ioerror@N counter (1-based)
         self.entries = parse_metadata(os.path.join(self.root, filename))
         with open(os.path.join(self.root, "speakers.json")) as f:
             self.speaker_map = json.load(f)
@@ -104,8 +118,24 @@ class SpeechDataset:
         return len(self.entries)
 
     def _feature(self, kind: str, speaker: str, basename: str) -> np.ndarray:
-        return np.load(
-            os.path.join(self.root, kind, f"{speaker}-{kind}-{basename}.npy")
+        from speakingstyle_tpu.training.resilience import retry_io
+
+        path = os.path.join(self.root, kind, f"{speaker}-{kind}-{basename}.npy")
+        self._feature_loads += 1
+        n = self._feature_loads
+
+        def load():
+            if self.fault_plan is not None and self.fault_plan.fire(
+                "loader_ioerror", n
+            ):
+                raise IOError(f"injected loader_ioerror@{n} ({path})")
+            return np.load(path)
+
+        if not self.retries:
+            return load()
+        return retry_io(
+            load, retries=self.retries, backoff=self.backoff,
+            exceptions=(OSError,), describe=path,
         )
 
     def __getitem__(self, idx: int) -> Dict:
@@ -130,6 +160,13 @@ class BucketedBatcher:
     ``max_src``/``max_mel`` cap the padded shapes (features beyond the cap
     are truncated, mirroring the reference Decoder's max_seq_len truncation,
     transformer/Models.py:154-162).
+
+    ``quarantine`` (training/resilience.Quarantine) makes sample loading
+    fault-tolerant: a sample that still fails after the dataset's own
+    retries is quarantined (logged + skipped) instead of killing the
+    prefetch worker, and the run fails only past the quarantine's
+    bad-sample budget. Without it, the first loader error propagates
+    (the pre-resilience behavior).
     """
 
     def __init__(
@@ -141,6 +178,7 @@ class BucketedBatcher:
         max_mel: Optional[int] = None,
         batch_pad_multiple: int = 1,
         seed: int = 1234,
+        quarantine=None,
     ):
         self.ds = dataset
         self.src_bucket = src_bucket
@@ -148,7 +186,22 @@ class BucketedBatcher:
         self.max_src = max_src
         self.max_mel = max_mel
         self.batch_pad_multiple = batch_pad_multiple
+        self.quarantine = quarantine
         self.rng = np.random.default_rng(seed)
+
+    def _fetch(self, idx: int) -> Optional[Dict]:
+        """Load one sample; quarantine-and-skip (returns None) on failure
+        when a quarantine is attached."""
+        sample_id = self.ds.entries[idx][0]
+        if self.quarantine is not None and sample_id in self.quarantine:
+            return None  # known-bad: don't pay the retries again
+        try:
+            return self.ds[idx]
+        except Exception as e:
+            if self.quarantine is None:
+                raise
+            self.quarantine.add(sample_id, e)  # raises past the budget
+            return None
 
     def _pad_batch(self, items: Sequence[Dict]) -> Batch:
         n_real = len(items)
@@ -220,7 +273,9 @@ class BucketedBatcher:
         super_size = ds.batch_size * ds.group_size
         for s in range(0, len(order), super_size):
             chunk = order[s : s + super_size]
-            items = [ds[int(i)] for i in chunk]
+            items = [it for i in chunk if (it := self._fetch(int(i))) is not None]
+            if not items:
+                continue
             if ds.sort:
                 idx = np.argsort([-len(d["text"]) for d in items], kind="stable")
                 items = [items[int(i)] for i in idx]

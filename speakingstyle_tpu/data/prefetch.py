@@ -4,6 +4,14 @@ Replaces the reference's 40-worker torch DataLoader (reference:
 train.py:33-41): feature loading + collate run on a worker thread pool while
 the device computes, and finished batches are device_put with the mesh's
 batch sharding ahead of time so each step starts with data already in HBM.
+
+Shutdown contract (ISSUE 2 hardening): the worker only ever blocks on a
+*stop-aware bounded put* (it polls the stop event while the queue is
+full, so ``stop()`` can never strand it), and it enqueues exactly one
+terminal item — either a clean end-of-stream or the error that killed
+the source — never both. ``stop()`` drains, joins the worker, and is
+idempotent; the class is also a context manager so short-lived
+prefetchers (validation passes) cannot leak their thread.
 """
 
 import queue
@@ -14,17 +22,37 @@ import jax
 
 from speakingstyle_tpu.data.dataset import Batch
 from speakingstyle_tpu.parallel.mesh import batch_sharding
+from speakingstyle_tpu.training.resilience import retry_io
+
+
+class _Terminal:
+    """The single end-of-stream marker; ``error`` is None for a clean end."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: Optional[BaseException] = None):
+        self.error = error
 
 
 class DevicePrefetcher:
     """Wrap a host batch iterator; yield (Batch, device_arrays) pairs."""
 
-    def __init__(self, batches: Iterator[Batch], mesh=None, depth: int = 2):
+    def __init__(
+        self,
+        batches: Iterator[Batch],
+        mesh=None,
+        depth: int = 2,
+        transfer_retries: int = 0,
+        transfer_backoff: float = 0.05,
+    ):
         self.batches = batches
         self.sharding = batch_sharding(mesh) if mesh is not None else None
         self.queue: "queue.Queue" = queue.Queue(maxsize=depth)
-        self.thread = threading.Thread(target=self._worker, daemon=True)
+        self.transfer_retries = transfer_retries
+        self.transfer_backoff = transfer_backoff
         self._stopped = threading.Event()
+        self._finished = False
+        self.thread = threading.Thread(target=self._worker, daemon=True)
         self.thread.start()
 
     def _put(self, batch: Batch):
@@ -48,32 +76,70 @@ class DevicePrefetcher:
                 }
         return batch, arrays
 
+    def _transfer(self, batch: Batch):
+        """Host→device transfer with retry-with-backoff on transient
+        runtime errors (re-entrant, unlike the source iterator)."""
+        if not self.transfer_retries:
+            return self._put(batch)
+        return retry_io(
+            lambda: self._put(batch),
+            retries=self.transfer_retries,
+            backoff=self.transfer_backoff,
+            exceptions=(OSError, jax.errors.JaxRuntimeError),
+            describe="device transfer",
+        )
+
+    def _bounded_put(self, item) -> bool:
+        """Put that can never outlive a stop(): polls the stop event while
+        the queue is full. Returns False if stopped before enqueueing."""
+        while not self._stopped.is_set():
+            try:
+                self.queue.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _worker(self):
+        terminal = _Terminal()
         try:
             for batch in self.batches:
                 if self._stopped.is_set():
                     return
-                self.queue.put(self._put(batch))
-        except Exception as e:  # surface loader errors on the consumer side
-            self.queue.put(e)
-        self.queue.put(None)
+                if not self._bounded_put(self._transfer(batch)):
+                    return
+        except BaseException as e:  # surfaced on the consumer side
+            terminal = _Terminal(e)
+        self._bounded_put(terminal)
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        item = self.queue.get()
-        if item is None:
+        if self._finished:
             raise StopIteration
-        if isinstance(item, Exception):
-            raise item
+        item = self.queue.get()
+        if isinstance(item, _Terminal):
+            self._finished = True
+            if item.error is not None:
+                raise item.error
+            raise StopIteration
         return item
 
     def stop(self):
+        """Idempotent: unblock + join the worker and drain the queue."""
         self._stopped.set()
-        # drain so the worker unblocks
+        # drain so a worker blocked in _bounded_put unblocks promptly
         try:
             while True:
                 self.queue.get_nowait()
         except queue.Empty:
             pass
+        self.thread.join(timeout=5.0)
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False

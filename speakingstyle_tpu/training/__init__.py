@@ -1,7 +1,17 @@
-"""Training: optax optimizer chain, sharded step functions, loop, checkpointing."""
+"""Training: optax optimizer chain, sharded step functions, loop,
+checkpointing, and the fault-tolerance layer (resilience + faults)."""
 
 from speakingstyle_tpu.training.checkpoint import CheckpointManager
+from speakingstyle_tpu.training.faults import FaultPlan
 from speakingstyle_tpu.training.optim import make_lr_schedule, make_optimizer
+from speakingstyle_tpu.training.resilience import (
+    BadSampleBudgetError,
+    GracefulShutdown,
+    Quarantine,
+    RollbackGuard,
+    TrainingDivergedError,
+    retry_io,
+)
 from speakingstyle_tpu.training.state import TrainState
 from speakingstyle_tpu.training.trainer import (
     TrainLogger,
@@ -14,6 +24,13 @@ from speakingstyle_tpu.training.trainer import (
 
 __all__ = [
     "CheckpointManager",
+    "FaultPlan",
+    "BadSampleBudgetError",
+    "GracefulShutdown",
+    "Quarantine",
+    "RollbackGuard",
+    "TrainingDivergedError",
+    "retry_io",
     "make_lr_schedule",
     "make_optimizer",
     "TrainState",

@@ -4,11 +4,25 @@ Replaces the reference's ``torch.save({"model", "optimizer"})`` every
 save_step (reference: train.py:155-165) and its ``ignore_layers`` +
 ``strict=False`` transfer-learning restore (reference: utils/model.py:15-32,
 config/BC2013/train.yaml:1).
+
+Resilience extensions (ISSUE 2, config: ``train.resilience.*``):
+
+  * **async saves** — ``save()`` snapshots the state to host memory
+    synchronously (donation safety: the next step may reuse the device
+    buffers) and hands the Orbax write to a background thread, so the
+    step loop never blocks on checkpoint I/O. ``wait()`` joins the
+    in-flight write and re-raises any write error.
+  * **retention** — keep the newest ``max_to_keep`` steps, plus (with
+    ``keep_best``) the best-val-loss step, pruned after each save.
+  * **robust latest-step restore** — ``restore(step=None)`` walks steps
+    newest-first and falls back past a partial/corrupt checkpoint
+    directory (crashed mid-write) instead of bricking the resume.
 """
 
 import os
 import re
-from typing import Optional, Sequence
+import threading
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import orbax.checkpoint as ocp
@@ -16,43 +30,185 @@ import orbax.checkpoint as ocp
 from speakingstyle_tpu.training.state import TrainState
 
 
+def _abstract_leaf(x):
+    """Shape/dtype(/sharding) template leaf for StandardRestore."""
+    if isinstance(x, jax.ShapeDtypeStruct):
+        return x
+    return ocp.utils.to_shape_dtype_struct(x)
+
+
 class CheckpointManager:
-    def __init__(self, directory: str, max_to_keep: Optional[int] = None):
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: Optional[int] = None,
+        async_save: bool = False,
+        keep_best: bool = False,
+    ):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
+        # retention is implemented here (max_to_keep + keep-best protection),
+        # not by Orbax options — Orbax's max_to_keep cannot pin the best
+        # step past the window
         self.manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep, create=True, enable_async_checkpointing=False
+                max_to_keep=None, create=True, enable_async_checkpointing=False
             ),
         )
+        self.max_to_keep = max_to_keep or None
+        self.keep_best = keep_best
+        self.async_save = async_save
+        self._metrics: Dict[int, float] = {}  # step -> val loss
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
 
-    def save(self, step: int, state: TrainState):
-        self.manager.save(step, args=ocp.args.StandardSave(state))
+    # -- saving -------------------------------------------------------------
+
+    def save(
+        self,
+        step: int,
+        state,
+        val_loss: Optional[float] = None,
+        block: bool = False,
+    ):
+        """Save ``state`` under ``step``. With ``async_save`` the Orbax
+        write runs on a background thread and this returns as soon as the
+        device->host snapshot is taken; pass ``block=True`` (final/flush
+        saves) to wait for the write. ``val_loss`` feeds keep-best
+        retention."""
+        self.wait()  # one write in flight at a time; surfaces prior errors
+        host_state = jax.device_get(state)
+        if self.async_save and not block:
+            self._thread = threading.Thread(
+                target=self._write_guarded,
+                args=(step, host_state, val_loss),
+                name=f"ckpt-save-{step}",
+                daemon=True,
+            )
+            self._thread.start()
+        else:
+            self._write(step, host_state, val_loss)
+
+    def _write_guarded(self, step: int, host_state, val_loss):
+        try:
+            self._write(step, host_state, val_loss)
+        except BaseException as e:  # surfaced by the next wait()/save()
+            self._error = e
+
+    def _write(self, step: int, host_state, val_loss):
+        self.manager.save(step, args=ocp.args.StandardSave(host_state))
         self.manager.wait_until_finished()
+        with self._lock:
+            if val_loss is not None:
+                self._metrics[step] = float(val_loss)
+        self._prune()
+
+    def save_in_flight(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def wait(self):
+        """Join any in-flight async write; re-raise its error, if any."""
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    # -- retention ----------------------------------------------------------
+
+    def best_step(self) -> Optional[int]:
+        """Step with the lowest recorded val loss (this process only)."""
+        with self._lock:
+            if not self._metrics:
+                return None
+            return min(self._metrics, key=self._metrics.get)
+
+    def _prune(self):
+        if not self.max_to_keep:
+            return
+        steps = sorted(self.manager.all_steps())
+        keep = set(steps[-self.max_to_keep:])
+        best = self.best_step()
+        if self.keep_best and best is not None:
+            keep.add(best)
+        for s in steps:
+            if s not in keep:
+                try:
+                    self.manager.delete(s)
+                except FileNotFoundError:
+                    pass  # already gone (e.g. a concurrent manual cleanup)
+
+    # -- reading ------------------------------------------------------------
+
+    def all_steps(self) -> List[int]:
+        return sorted(self.manager.all_steps())
+
+    def _restore_step(self, step: int, abstract):
+        """Restore one step via a standalone checkpointer aimed straight
+        at the step's item directory. The CheckpointManager is NOT used
+        here on purpose: a single failed ``manager.restore`` (a corrupt
+        step directory) permanently flips its item-handler registry into
+        multi-item mode, after which every later restore — including of
+        healthy steps — fails. The standalone path is stateless, so the
+        newest-first fallback scan can keep probing."""
+        path = os.path.join(self.directory, str(step), "default")
+        if not os.path.isdir(path):
+            raise FileNotFoundError(f"no checkpoint item at {path}")
+        return ocp.StandardCheckpointer().restore(path, abstract)
 
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()
 
     def restore(
         self,
-        state: TrainState,
+        state,
         step: Optional[int] = None,
         ignore_layers: Sequence[str] = (),
     ) -> TrainState:
-        """Restore into the shape of `state` (the abstract template).
+        """Restore into the shape of ``state`` (concrete arrays or a
+        jax.ShapeDtypeStruct template, e.g. ``TrainState.abstract()``).
 
-        ignore_layers: regexes matched against '/'-joined param paths; matching
-        leaves keep their freshly-initialized values AND the optimizer state is
-        reset (the reference reinitializes the optimizer when transferring).
+        ``step=None`` restores the latest step, falling back past
+        partial/corrupt checkpoint directories (newest-first) so one
+        crashed write cannot brick a resume. An explicitly requested
+        step fails loudly instead.
+
+        ignore_layers: regexes matched against '/'-joined param paths;
+        matching leaves keep their freshly-initialized values AND the
+        optimizer state is reset (the reference reinitializes the
+        optimizer when transferring). Requires concrete ``state``.
         """
-        step = step if step is not None else self.manager.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint found under {self.directory}")
-        abstract = jax.tree_util.tree_map(
-            ocp.utils.to_shape_dtype_struct, state
+        self.wait()  # never read around an in-flight write
+        abstract = jax.tree_util.tree_map(_abstract_leaf, state)
+        candidates = (
+            [step] if step is not None else sorted(self.all_steps(), reverse=True)
         )
-        restored = self.manager.restore(step, args=ocp.args.StandardRestore(abstract))
+        if not candidates:
+            raise FileNotFoundError(f"no checkpoint found under {self.directory}")
+        restored = None
+        failures = []
+        for s in candidates:
+            try:
+                restored = self._restore_step(s, abstract)
+                break
+            except Exception as e:
+                if step is not None:
+                    raise
+                failures.append((s, f"{type(e).__name__}: {e}"))
+                print(
+                    f"[checkpoint] step {s} under {self.directory} is not "
+                    f"restorable ({type(e).__name__}); trying the previous step"
+                )
+        if restored is None:
+            raise FileNotFoundError(
+                f"no restorable checkpoint under {self.directory}: "
+                f"all candidates failed: {failures}"
+            )
         if ignore_layers:
             patterns = [re.compile(p) for p in ignore_layers]
 
@@ -67,4 +223,9 @@ class CheckpointManager:
         return restored
 
     def close(self):
+        try:
+            self.wait()
+        except BaseException as e:
+            # close() runs in ``finally`` blocks: surface, don't mask
+            print(f"[checkpoint] in-flight save failed during close: {e}")
         self.manager.close()

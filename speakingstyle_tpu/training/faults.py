@@ -1,0 +1,111 @@
+"""Deterministic fault injection for the resilience layer.
+
+Every recovery path in training/resilience.py is exercised end-to-end by
+injecting the fault it guards against at an exact, named point.  The
+``SPEAKINGSTYLE_FAULTS`` environment variable holds a spec like
+
+    loader_ioerror@7;nan_grads@12;sigterm@20
+
+meaning: the 7th feature load raises a (transient) IOError once, the
+batch feeding train step 12 is NaN-poisoned once, and SIGTERM is
+delivered to the process once, right after step 20 completes.  Each
+entry fires exactly once — a retried load or a replayed step after
+rollback does NOT re-trip the same entry, which is what makes recovery
+observable.  Duplicate entries are allowed (``nan_grads@3;nan_grads@3``
+poisons the replay too — how the consecutive-rollback abort is tested).
+
+Counter semantics per kind:
+
+  ``loader_ioerror@N``  Nth call of ``SpeechDataset._feature`` (1-based,
+                        counted per dataset instance)
+  ``nan_grads@N``       the batch consumed by the train step whose
+                        post-increment step counter is N
+  ``sigterm@N``         delivered after step N completes
+
+The plan is plain Python state constructed per run (``FaultPlan.from_env``)
+and threaded explicitly into the sites — no module globals, so tests can
+run many faulted loops in one process.
+"""
+
+import dataclasses
+import os
+import signal
+from typing import List, Sequence, Tuple
+
+ENV_VAR = "SPEAKINGSTYLE_FAULTS"
+
+KINDS = ("loader_ioerror", "nan_grads", "sigterm")
+
+
+@dataclasses.dataclass
+class _Fault:
+    kind: str
+    at: int
+    fired: bool = False
+
+
+class FaultPlan:
+    """A parsed fault spec; each entry fires at most once."""
+
+    def __init__(self, faults: Sequence[_Fault] = ()):
+        self._faults: List[_Fault] = list(faults)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        faults = []
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            kind, sep, at = part.partition("@")
+            kind = kind.strip()
+            if not sep or kind not in KINDS:
+                raise ValueError(
+                    f"bad fault spec entry {part!r}: expected <kind>@<step> "
+                    f"with kind in {KINDS}"
+                )
+            try:
+                step = int(at)  # jaxlint: disable=JL004
+            except ValueError:
+                raise ValueError(
+                    f"bad fault spec entry {part!r}: step {at!r} is not an int"
+                ) from None
+            faults.append(_Fault(kind, step))
+        return cls(faults)
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan":
+        return cls.parse(os.environ.get(ENV_VAR, ""))
+
+    def __bool__(self) -> bool:
+        return bool(self._faults)
+
+    def fire(self, kind: str, at: int) -> bool:
+        """True exactly once per matching entry when the site's counter
+        hits the named value; False forever after."""
+        for f in self._faults:
+            if f.kind == kind and f.at == at and not f.fired:
+                f.fired = True
+                return True
+        return False
+
+    def pending(self) -> List[Tuple[str, int]]:
+        return [(f.kind, f.at) for f in self._faults if not f.fired]
+
+
+def poison_batch(arrays: dict) -> dict:
+    """NaN-poison a training batch (the ``nan_grads`` fault): multiplying
+    the mel targets by NaN drives every loss and every gradient non-finite
+    through the real loss/grad path, exactly like a diverged model or a
+    corrupt feature file would."""
+    import jax.numpy as jnp
+
+    out = dict(arrays)
+    out["mels"] = out["mels"] * jnp.float32(jnp.nan)
+    return out
+
+
+def deliver_sigterm():
+    """Deliver a real SIGTERM to this process (the ``sigterm`` fault), so
+    the actual installed handler — not a shortcut — is exercised."""
+    os.kill(os.getpid(), signal.SIGTERM)

@@ -9,6 +9,7 @@ shards, donates, and checkpoints as a unit.
 from typing import Any
 
 import flax.struct
+import jax
 import jax.numpy as jnp
 import optax
 
@@ -29,3 +30,19 @@ class TrainState:
             batch_stats=variables.get("batch_stats", {}),
             opt_state=tx.init(params),
         )
+
+    def abstract(self) -> "TrainState":
+        """Shape/dtype/sharding template of this state (no buffers).
+
+        Captured before the loop donates the concrete buffers, it stays
+        valid as a restore target forever — the NaN-rollback path in
+        run_training restores checkpoints into it after the live state
+        has been donated away."""
+
+        def to_sds(x):
+            sharding = getattr(x, "sharding", None)
+            return jax.ShapeDtypeStruct(
+                jnp.shape(x), jnp.result_type(x), sharding=sharding
+            )
+
+        return jax.tree_util.tree_map(to_sds, self)

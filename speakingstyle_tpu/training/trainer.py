@@ -20,7 +20,15 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from speakingstyle_tpu.analysis import contracts
 from speakingstyle_tpu.configs.config import Config
 from speakingstyle_tpu.models.loss import fastspeech2_loss
+from speakingstyle_tpu.training import faults, resilience
 from speakingstyle_tpu.training.state import TrainState
+
+# keys in the step's losses dict that are sentinel/bookkeeping, not losses
+_INTERNAL_LOSS_KEYS = ("_finite",)
+
+
+def public_losses(losses: Dict) -> Dict:
+    return {k: v for k, v in losses.items() if k not in _INTERNAL_LOSS_KEYS}
 
 
 def _model_kwargs(arrays: Dict, teacher_forced: bool) -> Dict:
@@ -48,10 +56,16 @@ def make_train_step(model, tx, cfg: Config, mesh=None, state_shardings=None):
     parallel/partition.train_state_shardings) engages tensor parallelism
     over the mesh's ``model`` axis; omitted, the state is replicated
     (pure DP — the reference's only strategy, SURVEY.md §2.4).
+
+    With ``train.resilience.nan_sentinel`` the step also returns
+    ``losses["_finite"]`` — an on-device all-finite reduction over losses
+    and grads, read host-side only at the log boundary (run_training's
+    rollback trigger; stripped from logs by ``public_losses``).
     """
     lambda_f = cfg.train.loss.lambda_f
     p_level = cfg.preprocess.preprocessing.pitch.feature
     e_level = cfg.preprocess.preprocessing.energy.feature
+    nan_sentinel = cfg.train.resilience.nan_sentinel
 
     def step_fn(state: TrainState, arrays: Dict, rng) -> tuple:
         # trace-time contracts: shape/dtype metadata only, so these run
@@ -90,6 +104,9 @@ def make_train_step(model, tx, cfg: Config, mesh=None, state_shardings=None):
         (_, (losses, batch_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(state.params)
+        if nan_sentinel:  # trace-time flag: compiled in or out, never branched
+            losses = dict(losses)
+            losses["_finite"] = resilience.all_finite(losses, grads)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
@@ -211,6 +228,16 @@ def run_training(
     synth_step — pass "default" for the GT-vs-predicted sample renderer.
     `profile_dir` enables a jax.profiler trace over the step window
     ``profile_steps`` (greenfield vs the reference — SURVEY.md §5).
+
+    Fault tolerance (``cfg.train.resilience``, ARCHITECTURE.md
+    "Resilience"): checkpoint saves are async and a final checkpoint is
+    always flushed — at loop end and on SIGTERM/SIGINT (preemption);
+    non-finite losses/grads at a log boundary roll the run back to the
+    last good checkpoint with a diverged data stream, aborting with
+    ``TrainingDivergedError`` after ``max_rollbacks`` consecutive trips;
+    loader errors are retried then quarantined per sample. Faults from
+    ``SPEAKINGSTYLE_FAULTS`` (training/faults.py) are injected to drill
+    each of those paths.
     """
     import time
     import jax.numpy as jnp
@@ -225,7 +252,9 @@ def run_training(
     from speakingstyle_tpu.training.optim import make_lr_schedule, make_optimizer
 
     steps = cfg.train.step
+    res = cfg.train.resilience
     total_step = max_steps if max_steps is not None else steps.total_step
+    plan = faults.FaultPlan.from_env()
 
     if cfg.train.fast_prng:
         try:
@@ -240,7 +269,12 @@ def run_training(
     state = TrainState.create(variables, tx)
     schedule = make_lr_schedule(cfg.train)
 
-    ckpt = CheckpointManager(cfg.train.path.ckpt_path)
+    ckpt = CheckpointManager(
+        cfg.train.path.ckpt_path,
+        max_to_keep=res.max_to_keep or None,
+        async_save=res.async_checkpointing,
+        keep_best=res.keep_best,
+    )
     if restore_step is not None:
         state = ckpt.restore(
             state,
@@ -270,15 +304,50 @@ def run_training(
 
     max_src = max_mel = cfg.model.max_seq_len
     pad_mult = mesh.shape["data"] if mesh is not None else 1
-    train_ds = SpeechDataset("train.txt", cfg, sort=True, drop_last=True)
-    batcher = BucketedBatcher(
-        train_ds,
-        max_src=max_src,
-        max_mel=max_mel,
-        batch_pad_multiple=pad_mult,
-        seed=cfg.train.seed,
+    train_ds = SpeechDataset(
+        "train.txt", cfg, sort=True, drop_last=True,
+        retries=res.loader_retries, backoff=res.loader_backoff,
+        fault_plan=plan,
     )
-    prefetch = DevicePrefetcher(iter(batcher), mesh=mesh)
+    quarantine = resilience.Quarantine(budget=res.bad_sample_budget)
+
+    step = int(state.step)
+    start_step = step  # profile window is relative to where this run begins
+
+    def make_stream(retry: int) -> DevicePrefetcher:
+        # the data seed folds in the resume point AND the rollback retry
+        # counter, so a resumed run doesn't replay the original stream
+        # from its beginning and a rolled-back run diverges past the
+        # batch window that tripped the sentinel
+        batcher = BucketedBatcher(
+            train_ds,
+            max_src=max_src,
+            max_mel=max_mel,
+            batch_pad_multiple=pad_mult,
+            seed=cfg.train.seed + start_step + 7919 * retry,
+            quarantine=quarantine,
+        )
+        return DevicePrefetcher(
+            iter(batcher), mesh=mesh, transfer_retries=res.loader_retries,
+            transfer_backoff=res.loader_backoff,
+        )
+
+    def fresh_state() -> TrainState:
+        # deterministic re-init (same seed): the rollback target when the
+        # sentinel trips before any checkpoint exists
+        s = TrainState.create(
+            init_variables(model, cfg, jax.random.PRNGKey(cfg.train.seed)), tx
+        )
+        if mesh is not None:
+            if state_shardings is not None:
+                from speakingstyle_tpu.parallel.partition import shard_train_state
+
+                s = shard_train_state(s, mesh)
+            else:
+                s = jax.device_put(s, NamedSharding(mesh, P()))
+        return s
+
+    prefetch = make_stream(0)
     val_ds = SpeechDataset("val.txt", cfg, sort=False, drop_last=False)
     val_batcher = BucketedBatcher(
         val_ds,
@@ -293,61 +362,127 @@ def run_training(
         synth_callback = default_synth_callback(cfg, logger, vocoder=vocoder)
     step_rng = jax.random.PRNGKey(cfg.train.seed + 1)
 
-    step = int(state.step)
-    start_step = step  # profile window is relative to where this run begins
+    # template for rollback restores: stays valid after donation consumes
+    # the live buffers (see TrainState.abstract)
+    abstract_template = state.abstract()
+    guard = resilience.RollbackGuard(res.max_rollbacks)
+    last_val: Optional[float] = None
+    last_saved: Optional[int] = None
     window_t0, window_step0, window_frames = time.perf_counter(), step, 0
     trace_active = False
+    shutdown = resilience.GracefulShutdown()
     try:
-        for batch, arrays in prefetch:
-            if step >= total_step:
-                break
-            if (
-                profile_dir is not None
-                and not trace_active
-                and profile_steps[0] <= step - start_step < profile_steps[1]
-            ):
-                jax.profiler.start_trace(profile_dir)
-                trace_active = True
-            # step_fn folds state.step into the key, so passing the same
-            # step_rng every iteration yields a fresh per-step stream
-            state, losses = train_step(state, arrays, step_rng)  # jaxlint: disable=JL006
-            step += 1
-            window_frames += int(batch.mel_lens.sum())  # host-side, no sync
-            if trace_active and step - start_step >= profile_steps[1]:
-                jax.block_until_ready(losses["total_loss"])
-                jax.profiler.stop_trace()
-                trace_active = False
+        with shutdown:
+            while step < total_step and not shutdown.requested:
+                try:
+                    batch, arrays = next(prefetch)
+                except StopIteration:
+                    break
+                if plan.fire("nan_grads", step + 1):
+                    arrays = faults.poison_batch(arrays)
+                if (
+                    profile_dir is not None
+                    and not trace_active
+                    and profile_steps[0] <= step - start_step < profile_steps[1]
+                ):
+                    jax.profiler.start_trace(profile_dir)
+                    trace_active = True
+                # step_fn folds state.step into the key, so passing the same
+                # step_rng every iteration yields a fresh per-step stream
+                state, losses = train_step(state, arrays, step_rng)  # jaxlint: disable=JL006
+                step += 1
+                window_frames += int(batch.mel_lens.sum())  # host-side, no sync
+                if trace_active and step - start_step >= profile_steps[1]:
+                    jax.block_until_ready(losses["total_loss"])
+                    jax.profiler.stop_trace()
+                    trace_active = False
+                if plan.fire("sigterm", step):
+                    faults.deliver_sigterm()
 
-            if logger and step % steps.log_step == 0:
-                jax.block_until_ready(losses["total_loss"])
-                # host boundary: losses are materialized for logging anyway
-                contracts.assert_tree_finite(losses, "train_step.losses")
-                lr = float(schedule(jnp.asarray(step - 1)))
-                logger.log(step, {k: float(v) for k, v in losses.items()}, lr=lr)
-                dt = time.perf_counter() - window_t0
-                if dt > 0 and step > window_step0:
-                    logger.log_throughput(
-                        step, (step - window_step0) / dt, window_frames / dt
-                    )
-                window_t0, window_step0, window_frames = (
-                    time.perf_counter(), step, 0,
+                if step % steps.log_step == 0:
+                    # host boundary: the loop blocks here for logging anyway,
+                    # so the sentinel read adds no extra sync point
+                    jax.block_until_ready(losses["total_loss"])
+                    if "_finite" in losses and not bool(losses["_finite"]):
+                        n = guard.trip(step)  # raises past max_rollbacks
+                        ckpt.wait()
+                        good = ckpt.latest_step()
+                        msg = (
+                            f"[resilience] non-finite losses/grads at step "
+                            f"{step}; rollback {n}/{res.max_rollbacks} to "
+                            + (f"checkpoint step {good}" if good is not None
+                               else "fresh init (no checkpoint yet)")
+                        )
+                        print(msg)
+                        if logger:
+                            logger.note(msg)
+                        prefetch.stop()
+                        if good is not None:
+                            state = ckpt.restore(abstract_template, step=good)
+                        else:
+                            state = fresh_state()
+                        step = int(state.step)  # jaxlint: disable=JL004
+                        prefetch = make_stream(guard.count)
+                        window_t0, window_step0, window_frames = (
+                            time.perf_counter(), step, 0,
+                        )
+                        continue
+                    guard.ok()
+                    if logger:
+                        contracts.assert_tree_finite(
+                            public_losses(losses), "train_step.losses"
+                        )
+                        lr = float(schedule(jnp.asarray(step - 1)))
+                        logger.log(
+                            step,
+                            {k: float(v) for k, v in public_losses(losses).items()},
+                            lr=lr,
+                        )
+                        dt = time.perf_counter() - window_t0
+                        if dt > 0 and step > window_step0:
+                            logger.log_throughput(
+                                step, (step - window_step0) / dt, window_frames / dt
+                            )
+                        window_t0, window_step0, window_frames = (
+                            time.perf_counter(), step, 0,
+                        )
+                if synth_callback is not None and step % steps.synth_step == 0:
+                    synth_callback(state, batch, arrays, step, model)
+                if step % steps.val_step == 0:
+                    with DevicePrefetcher(
+                        val_batcher.epoch(shuffle=False), mesh=mesh
+                    ) as val_prefetch:
+                        val_losses = evaluate(eval_step, state, val_prefetch)
+                    # evaluate() already returns host floats
+                    last_val = val_losses.get("total_loss", last_val)
+                    if logger:
+                        logger.log(step, val_losses, prefix="val")
+                if step % steps.save_step == 0:
+                    ckpt.save(step, state, val_loss=last_val)
+                    last_saved = step
+
+            # always flush a final checkpoint: covers total_step not
+            # divisible by save_step AND the SIGTERM/SIGINT preemption path
+            if step > start_step and last_saved != step:
+                ckpt.save(step, state, val_loss=last_val, block=True)
+                last_saved = step
+            if shutdown.requested:
+                msg = (
+                    f"[resilience] {shutdown.signame}: checkpoint flushed at "
+                    f"step {step}; exiting"
                 )
-            if synth_callback is not None and step % steps.synth_step == 0:
-                synth_callback(state, batch, arrays, step, model)
-            if step % steps.val_step == 0:
-                val_losses = evaluate(
-                    eval_step,
-                    state,
-                    DevicePrefetcher(val_batcher.epoch(shuffle=False), mesh=mesh),
-                )
+                print(msg)
                 if logger:
-                    logger.log(step, val_losses, prefix="val")
-            if step % steps.save_step == 0:
-                ckpt.save(step, jax.device_get(state))
+                    logger.note(msg)
     finally:
         if trace_active:
             jax.profiler.stop_trace()  # run ended inside the profile window
         prefetch.stop()
+        if quarantine.bad and logger:
+            logger.note(
+                f"[resilience] {len(quarantine.bad)} quarantined sample(s): "
+                f"{sorted(quarantine.bad)}"
+            )
         if logger:
             logger.close()
         ckpt.close()
@@ -384,6 +519,12 @@ class TrainLogger:
                 self.tb.add_scalar(f"{prefix}/{k}", float(v), step)
             if lr is not None:
                 self.tb.add_scalar(f"{prefix}/lr", lr, step)
+
+    def note(self, msg: str):
+        """Raw line into log.txt (resilience events: rollbacks, SIGTERM
+        flushes, quarantine summaries) — greppable next to the step log."""
+        self.txt.write(msg + "\n")
+        self.txt.flush()
 
     def log_throughput(self, step: int, steps_per_sec: float, frames_per_sec: float):
         self.txt.write(

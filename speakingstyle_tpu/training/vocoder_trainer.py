@@ -312,42 +312,112 @@ def train_vocoder(
     gen_params: Optional[Dict] = None,
     seed: int = 1234,
     restore_path: Optional[str] = None,
+    gen: Optional[Generator] = None,
+    mpd: Optional[MultiPeriodDiscriminator] = None,
+    msd: Optional[MultiScaleDiscriminator] = None,
 ):
     """The full vocoder GAN loop (reference: hifigan/train.py:24-267).
 
     ``restore_path`` resumes a previous run from a full-state checkpoint
     (save_vocoder's .msgpack); the loop continues from the restored
-    ``state.step`` up to ``max_steps`` total."""
+    ``state.step`` up to ``max_steps`` total.
+
+    Shares run_training's fault-tolerance layer (training/resilience.py,
+    config ``cfg.train.resilience``): SIGTERM/SIGINT flush a final
+    checkpoint, a final save always lands at loop end, non-finite metrics
+    at a log boundary roll back to the last saved .msgpack with a
+    diverged segment stream (abort past ``max_rollbacks`` consecutive
+    trips), and ``SPEAKINGSTYLE_FAULTS`` injects nan_grads/sigterm drills
+    (training/faults.py)."""
     from speakingstyle_tpu.data.mel_dataset import MelWavDataset
+    from speakingstyle_tpu.training import faults, resilience
+
+    res = cfg.train.resilience
+    plan = faults.FaultPlan.from_env()
 
     state, gen, mpd, msd, gen_tx, disc_tx = init_vocoder_state(
-        cfg, hp, jax.random.PRNGKey(seed), gen_params=gen_params
+        cfg, hp, jax.random.PRNGKey(seed), gen_params=gen_params,
+        gen=gen, mpd=mpd, msd=msd,
     )
     if restore_path:
         state = restore_vocoder(restore_path, state)
         print(f"[vocoder] restored step {int(state.step)} from {restore_path}")
+    # host-side structural template: stays valid after donation consumes
+    # the live device buffers (rollback restores re-use its structure)
+    template = jax.device_get(state)
     if mesh is not None:
         state = jax.device_put(state, NamedSharding(mesh, P()))
     train_step = make_vocoder_train_step(
         cfg, hp, gen, mpd, msd, gen_tx, disc_tx, mesh=mesh
     )
-    # fold the restored step into the dataset seed: a resumed run draws a
-    # fresh batch/segment stream instead of replaying the original run's
-    # sequence from its beginning
-    ds = MelWavDataset(
-        wav_paths, cfg, segment_size=hp.segment_size, batch_size=batch_size,
-        fine_tune_mel_dir=fine_tune_mel_dir, seed=seed + int(state.step),
-    )
+
+    def make_stream(retry: int):
+        # fold the restored step AND the rollback retry counter into the
+        # dataset seed: a resumed run draws a fresh batch/segment stream
+        # instead of replaying the original run's sequence, and a rolled-
+        # back run diverges past the window that tripped the sentinel
+        return iter(MelWavDataset(
+            wav_paths, cfg, segment_size=hp.segment_size,
+            batch_size=batch_size, fine_tune_mel_dir=fine_tune_mel_dir,
+            seed=seed + int(state.step) + 7919 * retry,
+        ))
+
+    stream = make_stream(0)
+    guard = resilience.RollbackGuard(res.max_rollbacks)
+    last_ckpt_file = restore_path
+    last_saved_step = int(state.step) if restore_path else None
     step = int(state.step)
     metrics = {}
-    for wavs, mels in ds:
-        if step >= max_steps:
-            break
-        state, metrics = train_step(state, jnp.asarray(wavs), jnp.asarray(mels))
-        step += 1
-        if step % log_every == 0:
-            msg = ", ".join(f"{k}: {float(v):.4f}" for k, v in metrics.items())
-            print(f"[vocoder] step {step}: {msg}")
-        if ckpt_path and step % save_every == 0:
-            save_vocoder(f"{ckpt_path}/vocoder_{step:08d}.msgpack", state)
+    with resilience.GracefulShutdown() as shutdown:
+        while step < max_steps and not shutdown.requested:
+            try:
+                wavs, mels = next(stream)
+            except StopIteration:
+                break
+            wavs = jnp.asarray(wavs)
+            if plan.fire("nan_grads", step + 1):
+                wavs = wavs * jnp.float32(jnp.nan)
+            state, metrics = train_step(state, wavs, jnp.asarray(mels))
+            step += 1
+            if plan.fire("sigterm", step):
+                faults.deliver_sigterm()
+            if step % log_every == 0:
+                # host boundary: metrics materialize for logging anyway
+                vals = {k: float(v) for k, v in metrics.items()}
+                if res.nan_sentinel and not all(
+                    np.isfinite(v) for v in vals.values()
+                ):
+                    n = guard.trip(step)  # raises past max_rollbacks
+                    print(
+                        f"[vocoder] non-finite metrics at step {step}; "
+                        f"rollback {n}/{res.max_rollbacks} to "
+                        + (last_ckpt_file or "fresh init (no checkpoint yet)")
+                    )
+                    if last_ckpt_file:
+                        state = restore_vocoder(last_ckpt_file, template)
+                    else:
+                        state = jax.device_put(template)
+                    if mesh is not None:
+                        state = jax.device_put(state, NamedSharding(mesh, P()))
+                    step = int(state.step)  # jaxlint: disable=JL004
+                    stream = make_stream(guard.count)
+                    continue
+                guard.ok()
+                msg = ", ".join(f"{k}: {v:.4f}" for k, v in vals.items())
+                print(f"[vocoder] step {step}: {msg}")
+            if ckpt_path and step % save_every == 0:
+                last_ckpt_file = f"{ckpt_path}/vocoder_{step:08d}.msgpack"
+                save_vocoder(last_ckpt_file, state)
+                last_saved_step = step
+        # always flush a final checkpoint: tail steps (max_steps not
+        # divisible by save_every) and the SIGTERM/SIGINT preemption path
+        if ckpt_path and step > 0 and last_saved_step != step:
+            last_ckpt_file = f"{ckpt_path}/vocoder_{step:08d}.msgpack"
+            save_vocoder(last_ckpt_file, state)
+            last_saved_step = step
+        if shutdown.requested:
+            print(
+                f"[vocoder] {shutdown.signame}: checkpoint flushed at step "
+                f"{step} ({last_ckpt_file or 'no ckpt_path set'}); exiting"
+            )
     return state, metrics

@@ -294,6 +294,89 @@ def test_jl006_negative_flax_rngs_dict_idiom():
 
 
 # ---------------------------------------------------------------------------
+# JL007 — swallowed exceptions
+# ---------------------------------------------------------------------------
+
+
+def test_jl007_positive_broad_except_pass():
+    assert "JL007" in _codes("""
+        def f(path):
+            try:
+                return open(path).read()
+            except Exception:
+                pass
+    """)
+
+
+def test_jl007_positive_bare_except_continue():
+    assert "JL007" in _codes("""
+        def f(paths):
+            out = []
+            for p in paths:
+                try:
+                    out.append(open(p).read())
+                except:
+                    continue
+            return out
+    """)
+
+
+def test_jl007_positive_silent_fallback_value():
+    # `except Exception: x = None` swallows just as silently as pass
+    assert "JL007" in _codes("""
+        def f(raw):
+            try:
+                data = parse(raw)
+            except Exception:
+                data = None
+            return data
+    """)
+
+
+def test_jl007_negative_specific_exception():
+    assert "JL007" not in _codes("""
+        def f():
+            try:
+                import tensorboardX
+            except ImportError:
+                pass
+    """)
+
+
+def test_jl007_negative_logged_or_reraised():
+    assert "JL007" not in _codes("""
+        def f(path):
+            try:
+                return open(path).read()
+            except Exception as e:
+                print(f"read failed: {e}")
+                raise
+    """)
+
+
+def test_jl007_negative_error_is_used():
+    # re-packaging the error (e.g. the prefetcher handing it to the
+    # consumer thread) is handling, not swallowing
+    assert "JL007" not in _codes("""
+        def f(q, fn):
+            try:
+                q.put(fn())
+            except Exception as e:
+                q.put(e)
+    """)
+
+
+def test_jl007_negative_outside_package():
+    assert "JL007" not in _codes("""
+        def f(path):
+            try:
+                return open(path).read()
+            except Exception:
+                pass
+    """, path="tests/fake.py")
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -404,7 +487,8 @@ def test_every_rule_is_non_vacuous():
     baselined) — rules that never fire are dead weight."""
     fired = {f.rule for f in linter.lint_paths()}
     fired |= {fp.split(":", 1)[0] for fp in linter.load_baseline()}
-    for code in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006"):
+    for code in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
+                 "JL007"):
         assert code in fired, f"{code} never fires on the real tree"
 
 
@@ -427,11 +511,13 @@ def test_cli_check_exits_zero_on_repo():
     ("JL006", "import jax\n\ndef f(rng):\n"
               "    a = jax.random.normal(rng, (2,))\n"
               "    b = jax.random.normal(rng, (2,))\n    return a + b\n"),
+    ("JL007", "def f(p):\n    try:\n        return open(p).read()\n"
+              "    except Exception:\n        pass\n"),
 ])
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
-    # JL004 is scoped to training/ paths
-    d = tmp_path / "training"
-    d.mkdir()
+    # JL004 is scoped to training/ paths; JL007 to speakingstyle_tpu/
+    d = tmp_path / "speakingstyle_tpu" / "training"
+    d.mkdir(parents=True)
     f = d / "fixture.py"
     f.write_text(src)
     rc = cli.main([str(f), "--no-baseline", "--check", "--select", code])

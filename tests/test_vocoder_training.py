@@ -348,3 +348,67 @@ def test_spectral_norm_sigma_converges_to_true_norm():
         np.testing.assert_allclose(float(sigma), true_sigma, rtol=1e-2)
         checked += 1
     assert checked >= 2, "no sigma/kernel pairs matched"
+
+
+@pytest.mark.slow
+def test_train_vocoder_loop_resilience(tmp_path, monkeypatch):
+    """The vocoder loop shares the fault-tolerance layer (ISSUE 2):
+    nan_grads rolls back to the last saved .msgpack, SIGTERM flushes a
+    final checkpoint, and the tail steps always land on disk."""
+    import dataclasses
+    import os
+
+    import scipy.io.wavfile
+
+    from speakingstyle_tpu.configs.config import ResilienceConfig
+    from speakingstyle_tpu.models.hifigan import Generator
+    from speakingstyle_tpu.training import faults
+    from speakingstyle_tpu.training.vocoder_trainer import (
+        VocoderHParams,
+        train_vocoder,
+    )
+
+    cfg = Config()
+    cfg = dataclasses.replace(
+        cfg,
+        train=dataclasses.replace(
+            cfg.train, resilience=ResilienceConfig(max_rollbacks=2)
+        ),
+    )
+    hp = VocoderHParams(segment_size=SEG, learning_rate=1e-4)
+    t = np.arange(SEG * 8) / 22050
+    wav = (0.5 * np.sin(2 * np.pi * 220 * t) * 30000).astype(np.int16)
+    scipy.io.wavfile.write(tmp_path / "a.wav", 22050, wav)
+    paths = [str(tmp_path / "a.wav")]
+    small = dict(gen=Generator(**SMALL_GEN), **_small_discs())
+    ckpt_dir = str(tmp_path / "ck")
+
+    # nan_grads@3 after a save at 2: rollback, then complete 5 steps with
+    # the tail (5 % save_every=2 != 0) flushed as a final checkpoint
+    monkeypatch.setenv(faults.ENV_VAR, "nan_grads@3")
+    state, metrics = train_vocoder(
+        cfg, paths, hp=hp, max_steps=5, batch_size=1, ckpt_path=ckpt_dir,
+        save_every=2, log_every=1, **small,
+    )
+    assert int(state.step) == 5
+    assert all(np.isfinite(float(v)) for v in metrics.values())
+    assert os.path.exists(f"{ckpt_dir}/vocoder_{5:08d}.msgpack")
+
+    # SIGTERM after step 6 (resumed from 5): flush lands at 6, resume
+    # completes to 8 with no step gap
+    monkeypatch.setenv(faults.ENV_VAR, "sigterm@6")
+    state, _ = train_vocoder(
+        cfg, paths, hp=hp, max_steps=8, batch_size=1, ckpt_path=ckpt_dir,
+        save_every=100, log_every=1,
+        restore_path=f"{ckpt_dir}/vocoder_{5:08d}.msgpack", **small,
+    )
+    assert int(state.step) == 6
+    assert os.path.exists(f"{ckpt_dir}/vocoder_{6:08d}.msgpack")
+    monkeypatch.delenv(faults.ENV_VAR)
+    state, metrics = train_vocoder(
+        cfg, paths, hp=hp, max_steps=8, batch_size=1, ckpt_path=ckpt_dir,
+        save_every=100, log_every=1,
+        restore_path=f"{ckpt_dir}/vocoder_{6:08d}.msgpack", **small,
+    )
+    assert int(state.step) == 8
+    assert all(np.isfinite(float(v)) for v in metrics.values())
