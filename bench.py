@@ -38,6 +38,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 import time
 
 # Estimated reference (PyTorch, unoptimized research code, fp32, Python
@@ -156,6 +157,35 @@ def _mark(msg: str) -> None:
     print(f"[bench +{time.monotonic() - _T0:7.1f}s] {msg}", file=sys.stderr, flush=True)
 
 
+def _device_watchdog(timeout_s: float, out_factory):
+    """Bound device acquisition: if ``jax.devices()`` is still blocked
+    after ``timeout_s``, print the structured JSON error line and exit 0
+    so the guarded parent records it instead of burning its whole attempt
+    budget (the BENCH_r05 null was a 520 s hang exactly here).
+
+    Returns an Event; the caller sets it once acquisition completed.
+    ``os._exit`` is deliberate — a backend stuck inside C++ ignores
+    interpreter-level interruption, and there is nothing to clean up in a
+    process that never acquired its devices.
+    """
+
+    acquired = threading.Event()
+
+    def fire():
+        if not acquired.wait(timeout_s):
+            _mark(f"device-acquisition watchdog fired after {timeout_s:.0f}s")
+            print(json.dumps(out_factory()), flush=True)
+            os._exit(0)
+
+    threading.Thread(target=fire, daemon=True, name="device-watchdog").start()
+    return acquired
+
+
+# seconds before a blocked jax.devices() is declared sick; well under the
+# 520 s guard budget so the structured error reaches the record
+DEVICE_ACQUISITION_TIMEOUT_S = 60.0
+
+
 def main(report_flops: bool = False, profile: bool = False,
          overrides: dict = None):
     _mark("importing jax")
@@ -181,7 +211,22 @@ def main(report_flops: bool = False, profile: bool = False,
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     _mark("acquiring devices (tunneled-TPU backend init hangs here when sick)")
+    acquired = _device_watchdog(
+        DEVICE_ACQUISITION_TIMEOUT_S,
+        lambda: {
+            "metric": "train_step_flops" if report_flops
+                      else "train_mel_frames_per_sec",
+            "value": None,
+            "unit": "FLOP/step" if report_flops else "mel-frames/sec/chip",
+            "vs_baseline": None,
+            "error": "device acquisition watchdog: jax.devices() still "
+                     f"blocked after {DEVICE_ACQUISITION_TIMEOUT_S:.0f}s "
+                     "(sick tunneled backend?)",
+            **({"overrides": overrides} if overrides else {}),
+        },
+    )
     devs = jax.devices()
+    acquired.set()
     _mark(f"devices acquired: {devs}")
     if not _is_tpu(devs[0]):
         # A sick tunnel can fail device init and silently fall back to the
@@ -492,6 +537,238 @@ def run_infer():
     }))
 
 
+def _tiny_serve_config():
+    """A deliberately small model + lattice for CPU serve measurement:
+    on CPU the point is the *scheduling* win (dispatch overhead
+    amortization through coalescing), which a tiny model isolates —
+    labeled "tiny-cpu" in every emitted line so it can never be confused
+    with a TPU number."""
+    from speakingstyle_tpu.configs.config import (
+        Config,
+        ModelConfig,
+        ReferenceEncoderConfig,
+        ServeConfig,
+        TransformerConfig,
+        VarianceEmbeddingConfig,
+        VariancePredictorConfig,
+    )
+
+    return Config(
+        model=ModelConfig(
+            transformer=TransformerConfig(
+                encoder_layer=1, decoder_layer=1, encoder_hidden=16,
+                decoder_hidden=16, conv_filter_size=16,
+                conv_kernel_size=(3, 1),
+            ),
+            reference_encoder=ReferenceEncoderConfig(
+                encoder_layer=1, encoder_head=2, encoder_hidden=16,
+                conv_layer=1, conv_filter_size=16,
+            ),
+            variance_predictor=VariancePredictorConfig(filter_size=16),
+            variance_embedding=VarianceEmbeddingConfig(n_bins=8),
+            postnet_embedding_dim=16, postnet_layers=2,
+            max_seq_len=48,
+            # bf16 is software-emulated on CPU; f32 keeps the tiny model's
+            # per-item compute honest
+            compute_dtype="float32",
+        ),
+        serve=ServeConfig(
+            batch_buckets=[1, 2, 4, 8, 16, 32],
+            src_buckets=[16],
+            mel_buckets=[32],
+            frames_per_phoneme=2,
+            max_wait_ms=5.0,
+            queue_depth=128,
+        ),
+    )
+
+
+def _serve_engine(tiny: bool):
+    """(engine, model_label): tiny CPU engine, or the flagship config +
+    random weights on an accelerator (compute identical to trained)."""
+    import numpy as np
+
+    import jax
+
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.serving.engine import SynthesisEngine
+    from speakingstyle_tpu.serving.lattice import BucketLattice
+    from speakingstyle_tpu.synthesis import get_vocoder
+
+    if tiny:
+        from speakingstyle_tpu.models.hifigan import Generator
+
+        cfg = _tiny_serve_config()
+        label = "tiny-cpu"
+        gen = Generator(
+            upsample_rates=(2, 2), upsample_kernel_sizes=(4, 4),
+            upsample_initial_channel=16, resblock_kernel_sizes=(3,),
+            resblock_dilation_sizes=((1,),),
+        )
+        n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+        vocoder = (gen, gen.init(
+            jax.random.PRNGKey(0), np.zeros((1, 8, n_mels), np.float32)
+        )["params"])
+    else:
+        from speakingstyle_tpu.configs.config import Config
+
+        cfg = _apply_overrides(Config(), TUNED_OVERRIDES)
+        label = "flagship"
+        vocoder = get_vocoder(cfg)
+    lattice = BucketLattice.from_config(cfg.serve)
+    n_position = max(lattice.max_mel, lattice.max_src,
+                     cfg.model.max_seq_len) + 1
+    model = build_model(cfg, n_position=n_position)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    engine = SynthesisEngine(
+        cfg, variables, vocoder=vocoder, lattice=lattice, model=model
+    )
+    return engine, label
+
+
+def _percentile(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(int(len(sorted_vals) * q), len(sorted_vals) - 1)
+    return sorted_vals[i]
+
+
+def run_serve(duration: float = 3.0, clients=(1, 2, 4, 8, 16, 32)):
+    """Offered-load sweep over the continuous-batching serve path.
+
+    Closed-loop clients (each submits, waits, resubmits) against the
+    AOT-precompiled engine + batcher; reports QPS, latency percentiles,
+    the batch-occupancy histogram, and the compile counter — which MUST
+    read zero after warmup (the acceptance invariant the smoke test also
+    asserts). Finishes with the coalesced-vs-sequential speedup line.
+    """
+    import numpy as np
+
+    import jax
+
+    from speakingstyle_tpu.serving.batcher import ContinuousBatcher
+    from speakingstyle_tpu.serving.engine import CompileMonitor, SynthesisRequest
+
+    _mark("building serve engine")
+    tiny = not _is_tpu(jax.devices()[0])
+    engine, label = _serve_engine(tiny)
+    n_mels = engine.n_mels
+    serve = engine.cfg.serve
+    rng = np.random.default_rng(0)
+    max_src = serve.src_buckets[-1]
+    max_len = min(max_src, serve.mel_buckets[-1] // serve.frames_per_phoneme)
+
+    def make_request(i: int) -> SynthesisRequest:
+        L = int(rng.integers(max(4, max_len // 2), max_len + 1))
+        T_ref = int(rng.integers(
+            max(8, serve.mel_buckets[-1] // 4), serve.mel_buckets[-1] + 1
+        ))
+        return SynthesisRequest(
+            id=f"bench{i}",
+            sequence=rng.integers(1, 300, L).astype(np.int32),
+            ref_mel=rng.standard_normal((T_ref, n_mels)).astype(np.float32),
+        )
+
+    _mark(f"precompiling {len(engine.lattice)} lattice points")
+    secs = engine.precompile()
+    compiles_startup = engine.compile_count
+    _mark(f"precompiled {compiles_startup} programs in {secs:.1f}s")
+
+    # warmup: one dispatch per batch bucket (first-execution transfer and
+    # dispatch-path setup; compiles already happened above)
+    for b in engine.lattice.batch_buckets:
+        engine.run([make_request(10_000 + b * 100 + j) for j in range(b)])
+
+    # sequential batch-1 baseline: the pre-serving deployment model —
+    # one request, one dispatch, no coalescing
+    seq_n = 0
+    with CompileMonitor() as mon:
+        t0 = time.perf_counter()
+        while time.perf_counter() - t0 < duration:
+            engine.run([make_request(seq_n)])
+            seq_n += 1
+        seq_dt = time.perf_counter() - t0
+    seq_qps = seq_n / seq_dt
+    print(json.dumps({
+        "metric": "serve_sequential_batch1_qps",
+        "value": round(seq_qps, 2),
+        "unit": "requests/sec (one dispatch per request)",
+        "model": label,
+        "compiles_during_run": mon.count,
+    }))
+
+    best_qps = 0.0
+    zero_compiles = True
+    for n_clients in clients:
+        batcher = ContinuousBatcher(engine)
+        latencies = []
+        lat_lock = threading.Lock()
+        done_count = [0]
+        stop_at = time.perf_counter() + duration
+
+        def client(cid: int):
+            i = 0
+            while time.perf_counter() < stop_at:
+                req = make_request(cid * 1_000_000 + i)
+                t0 = time.perf_counter()
+                try:
+                    batcher.submit(req).result(timeout=60)
+                except Exception:
+                    return
+                with lat_lock:
+                    latencies.append(time.perf_counter() - t0)
+                    done_count[0] += 1
+                i += 1
+
+        occupancy_before = dict(batcher.occupancy)
+        with CompileMonitor() as mon:
+            threads = [
+                threading.Thread(target=client, args=(c,), daemon=True)
+                for c in range(n_clients)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            batcher.close()
+        occupancy = {
+            k: v - occupancy_before.get(k, 0)
+            for k, v in sorted(batcher.occupancy.items())
+        }
+        latencies.sort()
+        qps = done_count[0] / dt
+        best_qps = max(best_qps, qps)
+        zero_compiles = zero_compiles and mon.count == 0
+        print(json.dumps({
+            "metric": "serve_offered_load",
+            "clients": n_clients,
+            "qps": round(qps, 2),
+            "p50_ms": round(1e3 * _percentile(latencies, 0.50), 1)
+                      if latencies else None,
+            "p95_ms": round(1e3 * _percentile(latencies, 0.95), 1)
+                      if latencies else None,
+            "p99_ms": round(1e3 * _percentile(latencies, 0.99), 1)
+                      if latencies else None,
+            "batch_occupancy": occupancy,
+            "compiles_during_serve": mon.count,
+            "model": label,
+        }))
+
+    print(json.dumps({
+        "metric": "serve_speedup_vs_sequential",
+        "value": round(best_qps / seq_qps, 2) if seq_qps else None,
+        "unit": "x (best coalesced QPS / sequential batch-1 QPS)",
+        "sequential_qps": round(seq_qps, 2),
+        "best_qps": round(best_qps, 2),
+        "zero_compiles_after_warmup": zero_compiles,
+        "aot_programs": compiles_startup,
+        "model": label,
+    }))
+    return best_qps / seq_qps if seq_qps else None
+
+
 def run_ab():
     """A/B the performance knobs (README "Performance knobs"): one process
     per variant so each gets a clean backend; prints one JSON line each."""
@@ -611,6 +888,10 @@ if __name__ == "__main__":
         run_breakdown()
     elif "--infer" in sys.argv:
         run_infer()
+    elif "--serve" in sys.argv:
+        dur = (float(sys.argv[sys.argv.index("--duration") + 1])
+               if "--duration" in sys.argv else 3.0)
+        run_serve(duration=dur)
     elif "--ab" in sys.argv:
         run_ab()
     elif "--inner" in sys.argv:

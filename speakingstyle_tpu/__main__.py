@@ -13,6 +13,7 @@ COMMANDS = (
     "vocode",
     "convert",
     "analyze",
+    "serve",
 )
 
 

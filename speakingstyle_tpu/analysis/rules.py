@@ -19,6 +19,8 @@ JL004  host-device sync inside training loops
 JL005  recompilation hazards in jitted signatures
 JL006  PRNG key reuse without split
 JL007  swallowed exceptions (broad except with no handling)
+JL008  XLA compilation in hot paths (jit/lower().compile() in loops or
+       request handlers; precompile/warmup functions exempt)
 """
 
 import ast
@@ -1060,6 +1062,83 @@ def rule_jl007(mod: ModuleInfo) -> Iterator[Finding]:
         )
 
 
+# ---------------------------------------------------------------------------
+# JL008 — compile in hot path
+# ---------------------------------------------------------------------------
+
+_JIT_CALL_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit"}
+# functions sanctioned to compile in a loop: the AOT startup pattern
+# (serving/engine.py precompile) — hoist compiles INTO one of these
+_COMPILE_EXEMPT_MARKERS = ("precompile", "warmup", "warm_up")
+
+
+def _is_handler_name(name: str) -> bool:
+    """Request-handler heuristics: http.server's ``do_GET``-style methods,
+    and anything named like a handler (``handle_*``, ``*_handler``,
+    ``on_request``, ...)."""
+    low = name.lower()
+    return (name.startswith("do_") and name[3:].isupper()) or \
+        "handle" in low or "request" in low
+
+
+def _is_aot_compile_chain(node: ast.Call) -> bool:
+    """``<expr>.lower(...).compile(...)`` — the AOT idiom. Matching the
+    full chain (not bare ``.compile()``) keeps re.compile & co. silent."""
+    f = node.func
+    return (
+        isinstance(f, ast.Attribute) and f.attr == "compile"
+        and isinstance(f.value, ast.Call)
+        and isinstance(f.value.func, ast.Attribute)
+        and f.value.func.attr == "lower"
+    )
+
+
+def rule_jl008(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL008: XLA compilation in a hot path — ``jax.jit``/``pjit`` or a
+    ``.lower(...).compile()`` chain invoked inside a loop, or anywhere in
+    a request-handler-shaped function.
+
+    A compile is 10^5-10^7x a dispatch; in a loop it recompiles per
+    iteration (a fresh ``jax.jit`` object never shares cache entries with
+    the last iteration's), and in a request handler it stalls a live
+    request behind XLA. Hoist compilation to startup: build the jits
+    once, or AOT-precompile the shape lattice (serving/engine.py). Loops
+    inside functions named ``precompile``/``warmup`` are exempt — that IS
+    the sanctioned startup pattern.
+    """
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        is_jit = _dotted(node.func) in _JIT_CALL_NAMES
+        is_aot = _is_aot_compile_chain(node)
+        if not (is_jit and not mod.is_in_traced_context(node)) and not is_aot:
+            continue
+        qual = mod.qualname(node)
+        if any(m in qual.lower() for m in _COMPILE_EXEMPT_MARKERS):
+            continue
+        what = _dotted(node.func) if is_jit else ".lower().compile()"
+        fn = mod.enclosing_function(node)
+        in_loop = bool(mod.enclosing_loops(node))
+        in_handler = fn is not None and _is_handler_name(fn.name)
+        if not in_loop and not in_handler:
+            continue
+        where = "loop" if in_loop else "request handler"
+        yield Finding(
+            rule="JL008",
+            path=mod.path,
+            line=node.lineno,
+            context=qual,
+            detail=f"{what} in {where}",
+            message=(
+                f"`{what}` inside a {where} ({qual}): compilation in the "
+                "hot path — each hit costs an XLA compile (not a cached "
+                "dispatch). Build the jit once at startup, or AOT-"
+                "precompile the shape lattice (see serving/engine.py); "
+                "precompile/warmup-named functions are exempt."
+            ),
+        )
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -1068,4 +1147,5 @@ RULES = {
     "JL005": rule_jl005,
     "JL006": rule_jl006,
     "JL007": rule_jl007,
+    "JL008": rule_jl008,
 }

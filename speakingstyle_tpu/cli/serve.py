@@ -1,0 +1,123 @@
+"""``serve`` command: the continuous-batching text->wav HTTP server.
+
+Starts the AOT-precompiled synthesis engine (serving/engine.py) over the
+checkpoint named by ``--restore_step``, precompiles the full shape-bucket
+lattice (``serve.*`` config block), then serves:
+
+  POST /synthesize  {"text": ..., "speaker_id"?, "pitch_control"?,
+                     "energy_control"?, "duration_control"?, "ref_audio"?}
+                    -> audio/wav
+  GET  /healthz     -> engine/batcher stats (compile counter must stay at
+                       its post-startup value: steady state never compiles)
+
+No reference counterpart: the reference's synthesize.py is one-shot and
+pays a fresh CUDA/compile warmup per invocation.
+"""
+
+import argparse
+
+from speakingstyle_tpu.cli import add_config_args, config_from_args
+
+
+def build_parser(parser=None):
+    parser = parser or argparse.ArgumentParser(description=__doc__)
+    add_config_args(parser, required=True)
+    parser.add_argument("--restore_step", type=int, required=True)
+    parser.add_argument(
+        "--ref_audio", type=str, default=None,
+        help="default style-reference wav used when a request carries none",
+    )
+    parser.add_argument(
+        "--vocoder_ckpt", type=str, default=None,
+        help="HiFi-GAN generator checkpoint (.pth.tar or .msgpack)",
+    )
+    parser.add_argument(
+        "--griffin_lim", action="store_true",
+        help="no neural vocoder: /synthesize returns the mel as JSON",
+    )
+    parser.add_argument("--host", type=str, default=None,
+                        help="override serve.host")
+    parser.add_argument("--port", type=int, default=None,
+                        help="override serve.port")
+    return parser
+
+
+def load_engine(cfg, restore_step: int, vocoder_ckpt=None, griffin_lim=False):
+    """Restore the acoustic checkpoint + vocoder and build the engine.
+
+    Shared by ``serve`` and ``synthesize`` so the CLI one-shot path and
+    the server execute the identical padded-dispatch code.
+    """
+    import jax
+
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.serving.engine import SynthesisEngine
+    from speakingstyle_tpu.serving.lattice import BucketLattice
+    from speakingstyle_tpu.synthesis import get_vocoder
+    from speakingstyle_tpu.training.checkpoint import CheckpointManager
+    from speakingstyle_tpu.training.optim import make_optimizer
+    from speakingstyle_tpu.training.state import TrainState
+
+    lattice = BucketLattice.from_config(cfg.serve)
+    n_position = max(lattice.max_mel, lattice.max_src, cfg.model.max_seq_len) + 1
+    model = build_model(cfg, n_position=n_position)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(cfg.train.seed))
+    state = TrainState.create(variables, make_optimizer(cfg.train))
+    ckpt = CheckpointManager(cfg.train.path.ckpt_path)
+    state = ckpt.restore(
+        state,
+        step=restore_step if restore_step > 0 else None,
+        ignore_layers=cfg.train.ignore_layers,
+    )
+    ckpt.close()
+    vocoder = None if griffin_lim else get_vocoder(cfg, vocoder_ckpt)
+    return SynthesisEngine(
+        cfg,
+        {"params": state.params, "batch_stats": state.batch_stats},
+        vocoder=vocoder,
+        lattice=lattice,
+        model=model,
+    )
+
+
+def main(args):
+    from speakingstyle_tpu.serving.server import (
+        SynthesisServer,
+        TextFrontend,
+        load_ref_mel,
+    )
+
+    cfg = config_from_args(args)
+    engine = load_engine(
+        cfg, args.restore_step,
+        vocoder_ckpt=args.vocoder_ckpt, griffin_lim=args.griffin_lim,
+    )
+    print(f"precompiling {len(engine.lattice)} lattice points ...", flush=True)
+    secs = engine.precompile()
+    print(
+        f"precompiled {engine.compile_count} programs in {secs:.1f}s; "
+        "steady-state serving performs zero compiles", flush=True,
+    )
+
+    default_ref = (
+        load_ref_mel(cfg, args.ref_audio) if args.ref_audio else None
+    )
+    server = SynthesisServer(
+        engine,
+        TextFrontend(cfg, default_ref),
+        host=args.host,
+        port=args.port,
+    )
+    host, port = server.address[:2]
+    print(f"serving on http://{host}:{port} "
+          "(POST /synthesize, GET /healthz)", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        print("shutting down (flushing admitted requests) ...", flush=True)
+    finally:
+        server.shutdown()
+
+
+if __name__ == "__main__":
+    main(build_parser().parse_args())

@@ -5,10 +5,15 @@ style encoder always needs a reference mel); controls accept either a
 scalar for the whole utterance or — beyond the reference CLI, matching its
 notebooks' fine-control workflow (notebooks/control.ipynb) — a per-word
 list like ``--duration_control 1.0,2.5,1.0``.
+
+Both modes run through the serving engine's shape-bucket lattice and
+continuous batcher (serving/): every dispatch is padded to a lattice
+point, so the CLI one-shot path and the HTTP server execute the identical
+compiled programs — there is exactly one padded-dispatch code path in the
+tree.
 """
 
 import argparse
-import json
 import os
 
 import numpy as np
@@ -67,28 +72,25 @@ def _parse_control(spec: str):
     return parts[0] if len(parts) == 1 else parts
 
 
-def _control_array(spec, spans, length):
-    """Scalar passes through; a per-word list becomes a [1, length] array."""
-    from speakingstyle_tpu.control import expand_word_controls, pad_control
+def _control_value(spec, spans):
+    """Scalar passes through; a per-word list becomes a per-phoneme array
+    (the engine pads it to the dispatch bucket)."""
+    from speakingstyle_tpu.control import expand_word_controls
 
     if np.isscalar(spec):
         return float(spec)
     if spans is None:
         raise SystemExit("per-word controls need single mode with English text")
-    return pad_control(expand_word_controls(spans, spec), length)
+    return np.asarray(expand_word_controls(spans, spec), np.float32)
 
 
 def main(args):
-    import jax
-
-    from speakingstyle_tpu.audio.stft import MelExtractor, get_mel_from_wav
-    from speakingstyle_tpu.audio.tools import load_wav
-    from speakingstyle_tpu.data.dataset import Batch, TextBatcher, bucket_length
-    from speakingstyle_tpu.models.factory import build_model, init_variables
-    from speakingstyle_tpu.synthesis import get_vocoder, synth_samples
-    from speakingstyle_tpu.training.checkpoint import CheckpointManager
-    from speakingstyle_tpu.training.optim import make_optimizer
-    from speakingstyle_tpu.training.state import TrainState
+    from speakingstyle_tpu.cli.serve import load_engine
+    from speakingstyle_tpu.data.dataset import TextBatcher
+    from speakingstyle_tpu.serving.batcher import ContinuousBatcher
+    from speakingstyle_tpu.serving.engine import SynthesisRequest
+    from speakingstyle_tpu.serving.server import TextFrontend, load_ref_mel
+    from speakingstyle_tpu.synthesis import render_result
 
     if args.mode == "batch":
         assert args.source is not None and args.text is None
@@ -105,30 +107,26 @@ def main(args):
     result_dir = os.path.join(cfg.train.path.result_path, str(args.restore_step))
     os.makedirs(result_dir, exist_ok=True)
 
-    model = build_model(cfg)
-    variables = init_variables(model, cfg, jax.random.PRNGKey(cfg.train.seed))
-    state = TrainState.create(variables, make_optimizer(cfg.train))
-    ckpt = CheckpointManager(cfg.train.path.ckpt_path)
-    state = ckpt.restore(
-        state,
-        step=args.restore_step if args.restore_step > 0 else None,
-        ignore_layers=cfg.train.ignore_layers,
+    # one padded-dispatch code path: the same engine the server runs. The
+    # one-shot CLI skips the full-lattice precompile — the engine compiles
+    # the buckets this workload actually touches, on miss, under its lock.
+    engine = load_engine(
+        cfg, args.restore_step,
+        vocoder_ckpt=args.vocoder_ckpt, griffin_lim=args.griffin_lim,
     )
-    ckpt.close()
-
-    vocoder = None if args.griffin_lim else get_vocoder(cfg, args.vocoder_ckpt)
 
     p_c = _parse_control(args.pitch_control)
     e_c = _parse_control(args.energy_control)
     d_c = _parse_control(args.duration_control)
 
-    spans = None
+    requests = []
     if args.mode == "single":
         from speakingstyle_tpu.control import english_word_spans, spans_to_sequence
         from speakingstyle_tpu.text.g2p import preprocess_text, read_lexicon
 
         lang = pp.text.language
         lex_path = cfg.preprocess.path.lexicon_path or None
+        spans = None
         if lang == "en":
             spans = english_word_spans(
                 args.text, read_lexicon(lex_path) if lex_path else {}
@@ -140,85 +138,58 @@ def main(args):
                 args.text, lang, lex_path, list(pp.text.text_cleaners)
             )
 
-        wav, _ = load_wav(args.ref_audio, target_sr=pp.audio.sampling_rate)
-        mel, _ = get_mel_from_wav(
-            wav,
-            MelExtractor(
-                pp.stft.filter_length, pp.stft.hop_length, pp.stft.win_length,
-                pp.mel.n_mel_channels, pp.audio.sampling_rate,
-                pp.mel.mel_fmin, pp.mel.mel_fmax,
-            ),
-        )
-        mel = mel.T  # [T, n_mels]
-
-        speakers_path = os.path.join(
-            cfg.preprocess.path.preprocessed_path, "speakers.json"
-        )
+        # speaker NAME from speakers.json or raw numeric id (the reference
+        # crashes on exactly this lookup — synthesize.py:272, SURVEY.md §2.5)
         speaker = 0
         if cfg.model.multi_speaker:
-            # accept a speaker NAME from speakers.json (its keys) or a raw
-            # numeric id (the reference crashes on exactly this lookup —
-            # synthesize.py:272, SURVEY.md §2.5)
-            if os.path.exists(speakers_path):
-                with open(speakers_path) as f:
-                    speaker_map = json.load(f)
-                if args.speaker_id in speaker_map:
-                    speaker = speaker_map[args.speaker_id]
-                elif args.speaker_id.lstrip("-").isdigit():
-                    speaker = int(args.speaker_id)
-                else:
-                    raise SystemExit(
-                        f"unknown speaker {args.speaker_id!r}; known: "
-                        f"{sorted(speaker_map)[:10]}..."
-                    )
-            elif args.speaker_id.lstrip("-").isdigit():
-                speaker = int(args.speaker_id)
+            try:
+                speaker = TextFrontend(cfg, None).speaker(args.speaker_id)
+            except ValueError as e:
+                raise SystemExit(str(e))
 
-        L = bucket_length(len(sequence), 16)
-        T = bucket_length(mel.shape[0], 64)
-        texts = np.zeros((1, L), np.int32)
-        texts[0, : len(sequence)] = sequence
-        mels = np.zeros((1, T, mel.shape[1]), np.float32)
-        mels[0, : mel.shape[0]] = mel
         import re as _re
 
         safe_id = _re.sub(r"[^\w\-]+", "_", args.text[:100]).strip("_")[:60]
-        batches = [
-            Batch(
-                n_real=1,
-                ids=[safe_id or "utt"],
-                raw_texts=[args.text],
-                speakers=np.asarray([speaker], np.int32),
-                texts=texts,
-                src_lens=np.asarray([len(sequence)], np.int32),
-                mels=mels,
-                mel_lens=np.asarray([mel.shape[0]], np.int32),
-                pitches=np.zeros((1, L), np.float32),
-                energies=np.zeros((1, L), np.float32),
-                durations=np.zeros((1, L), np.int32),
-            )
-        ]
+        requests.append(SynthesisRequest(
+            id=safe_id or "utt",
+            sequence=np.asarray(sequence, np.int32),
+            ref_mel=load_ref_mel(cfg, args.ref_audio),
+            speaker=speaker,
+            raw_text=args.text,
+            p_control=_control_value(p_c, spans),
+            e_control=_control_value(e_c, spans),
+            d_control=_control_value(d_c, spans),
+        ))
     else:
-        batches = TextBatcher(args.source, cfg).epoch()
+        if not np.isscalar(p_c) or not np.isscalar(e_c) or not np.isscalar(d_c):
+            raise SystemExit("per-word controls need single mode with English text")
+        ds = TextBatcher(args.source, cfg)
+        for i in range(len(ds)):
+            item = ds[i]
+            if item["mel"] is None:
+                raise SystemExit(
+                    f"no reference mel for {item['id']!r}: the style encoder "
+                    "requires one (reference: synthesize.py --ref_audio)"
+                )
+            requests.append(SynthesisRequest(
+                id=item["id"],
+                sequence=item["text"],
+                ref_mel=item["mel"],
+                speaker=item["speaker"],
+                raw_text=item["raw_text"],
+                p_control=float(p_c), e_control=float(e_c),
+                d_control=float(d_c),
+            ))
 
-    for batch in batches:
-        L = batch.texts.shape[1]
-        out = model.apply(
-            {"params": state.params, "batch_stats": state.batch_stats},
-            speakers=batch.speakers,
-            texts=batch.texts,
-            src_lens=batch.src_lens,
-            mels=batch.mels,
-            mel_lens=batch.mel_lens,
-            max_mel_len=int(cfg.model.max_seq_len),
-            p_control=_control_array(p_c, spans, L),
-            e_control=_control_array(e_c, spans, L),
-            d_control=_control_array(d_c, spans, L),
-            deterministic=True,
+    with ContinuousBatcher(engine) as batcher:
+        futures = [batcher.submit(r) for r in requests]
+        results = [f.result() for f in futures]
+    for result in results:
+        path = render_result(
+            result, cfg, result_dir, plot=args.plot,
+            vocoder=None,  # griffin_lim fallback inverts host-side
         )
-        paths = synth_samples(batch, out, vocoder, cfg, result_dir, plot=args.plot)
-        for p in paths:
-            print("wrote", p)
+        print("wrote", path)
 
 
 if __name__ == "__main__":

@@ -173,6 +173,12 @@ class ModelConfig:
     multi_speaker: bool = False
     max_seq_len: int = 1000
     vocoder: VocoderConfig = field(default_factory=VocoderConfig)
+    # postnet topology (reference hardcodes 512/5/5 — model/modules.py);
+    # exposed so scaled-down configs (tests, the CPU serve bench) shrink
+    # the whole model, not all-but-the-postnet
+    postnet_embedding_dim: int = 512
+    postnet_kernel_size: int = 5
+    postnet_layers: int = 5
     # TPU-specific knobs (no reference counterpart):
     compute_dtype: str = "bfloat16"  # activations/matmul dtype under jit
     # conv1d lowering for the FLOP-dominant conv stacks (ops/conv.py):
@@ -394,13 +400,77 @@ class TrainConfig:
             )
 
 
+# ---------------------------------------------------------------------------
+# serve.* — the synthesis server (serving/; no reference counterpart)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ServeConfig:
+    """Continuous-batching synthesis server knobs (serving/engine.py,
+    serving/batcher.py).
+
+    The three bucket lists span the AOT-precompiled shape lattice: every
+    served dispatch runs at some ``(batch, L_src, T_mel)`` drawn from
+    their cross product, compiled once at server start. ``T_mel`` bounds
+    BOTH the style-reference mel input and the free-run output buffer
+    (``max_mel_len``), so one lattice axis covers both mel shapes.
+    """
+
+    # batch sizes the engine compiles for; a dispatch of n requests runs
+    # at the smallest bucket >= n
+    batch_buckets: List[int] = field(default_factory=lambda: [1, 2, 4, 8])
+    # padded text lengths (multiples of the dataset src bucket work well;
+    # the top bucket caps the longest admissible utterance)
+    src_buckets: List[int] = field(default_factory=lambda: [32, 64, 128, 256])
+    # padded mel lengths: reference-mel input AND free-run output buffer
+    mel_buckets: List[int] = field(default_factory=lambda: [256, 512, 1000])
+    # admission deadline: a request is dispatched at most this long after
+    # arrival (sooner when a full batch_buckets[-1] coalesces first)
+    max_wait_ms: float = 10.0
+    # bounded admission queue depth; submit blocks (stop-aware) when full
+    queue_depth: int = 64
+    # output-buffer sizing bound: a request with n phonemes needs
+    # T_mel >= n * frames_per_phoneme (predictions past the buffer are
+    # truncated, matching the reference's max_seq_len clamp)
+    frames_per_phoneme: int = 12
+    # donate request buffers into the compiled programs (XLA reuses the
+    # padded input HBM for outputs; ignored with a warning on CPU)
+    donate_buffers: bool = True
+    # host->device transfer retry-with-backoff (DevicePrefetcher discipline)
+    transfer_retries: int = 0
+    transfer_backoff: float = 0.05
+    host: str = "127.0.0.1"
+    port: int = 8400
+
+    def __post_init__(self):
+        for name in ("batch_buckets", "src_buckets", "mel_buckets"):
+            vals = getattr(self, name)
+            if not vals:
+                raise ValueError(f"serve.{name} must be non-empty")
+            if any(v <= 0 for v in vals):
+                raise ValueError(f"serve.{name} must be positive, got {vals}")
+            if sorted(vals) != list(vals) or len(set(vals)) != len(vals):
+                raise ValueError(
+                    f"serve.{name} must be strictly ascending, got {vals}"
+                )
+        if self.max_wait_ms < 0:
+            raise ValueError(f"serve.max_wait_ms must be >= 0, got {self.max_wait_ms}")
+        if self.queue_depth <= 0:
+            raise ValueError(f"serve.queue_depth must be > 0, got {self.queue_depth}")
+        if self.frames_per_phoneme <= 0:
+            raise ValueError(
+                f"serve.frames_per_phoneme must be > 0, got {self.frames_per_phoneme}"
+            )
+
+
 @dataclass(frozen=True)
 class Config:
-    """The full (preprocess, model, train) triple."""
+    """The full (preprocess, model, train) triple, plus the serve block."""
 
     preprocess: PreprocessConfig = field(default_factory=PreprocessConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
 
 def load_yaml(path: str) -> Dict[str, Any]:
@@ -426,8 +496,13 @@ def load_config(
         train = train or os.path.join(base, "train.yaml")
     pc = _build(PreprocessConfig, load_yaml(preprocess)) if preprocess else PreprocessConfig()
     mc = _build(ModelConfig, load_yaml(model)) if model else ModelConfig()
-    tc = _build(TrainConfig, load_yaml(train)) if train else TrainConfig()
-    return Config(preprocess=pc, model=mc, train=tc)
+    # the serve.* block rides in train.yaml (a fourth file for a handful of
+    # server knobs would be ceremony); absent -> defaults
+    train_data = load_yaml(train) if train else {}
+    serve_data = train_data.pop("serve", None) if isinstance(train_data, dict) else None
+    tc = _build(TrainConfig, train_data) if train else TrainConfig()
+    sc = _build(ServeConfig, serve_data, "serve") if serve_data else ServeConfig()
+    return Config(preprocess=pc, model=mc, train=tc, serve=sc)
 
 
 def load_stats(preprocessed_path: str) -> Dict[str, List[float]]:

@@ -25,13 +25,36 @@ from speakingstyle_tpu.parallel.mesh import batch_sharding
 from speakingstyle_tpu.training.resilience import retry_io
 
 
-class _Terminal:
-    """The single end-of-stream marker; ``error`` is None for a clean end."""
+class Terminal:
+    """The single end-of-stream marker; ``error`` is None for a clean end.
+
+    Shared with the serving admission queue (serving/batcher.py): any
+    bounded producer/consumer pair in this codebase signals end-of-stream
+    with exactly one of these, never a sentinel-less close.
+    """
 
     __slots__ = ("error",)
 
     def __init__(self, error: Optional[BaseException] = None):
         self.error = error
+
+
+def bounded_put(q: "queue.Queue", item, stopped: threading.Event,
+                poll: float = 0.05) -> bool:
+    """Bounded put that can never outlive a stop: polls ``stopped`` while
+    the queue is full. Returns False if stopped before enqueueing.
+
+    The load-bearing shutdown primitive shared by DevicePrefetcher and
+    the serving batcher — a plain ``Queue.put`` on a full queue blocks
+    forever if the consumer died, stranding the producer thread.
+    """
+    while not stopped.is_set():
+        try:
+            q.put(item, timeout=poll)
+            return True
+        except queue.Full:
+            continue
+    return False
 
 
 class DevicePrefetcher:
@@ -90,18 +113,11 @@ class DevicePrefetcher:
         )
 
     def _bounded_put(self, item) -> bool:
-        """Put that can never outlive a stop(): polls the stop event while
-        the queue is full. Returns False if stopped before enqueueing."""
-        while not self._stopped.is_set():
-            try:
-                self.queue.put(item, timeout=0.05)
-                return True
-            except queue.Full:
-                continue
-        return False
+        """Stop-aware bounded put (see module-level ``bounded_put``)."""
+        return bounded_put(self.queue, item, self._stopped)
 
     def _worker(self):
-        terminal = _Terminal()
+        terminal = Terminal()
         try:
             for batch in self.batches:
                 if self._stopped.is_set():
@@ -109,7 +125,7 @@ class DevicePrefetcher:
                 if not self._bounded_put(self._transfer(batch)):
                     return
         except BaseException as e:  # surfaced on the consumer side
-            terminal = _Terminal(e)
+            terminal = Terminal(e)
         self._bounded_put(terminal)
 
     def __iter__(self):
@@ -119,7 +135,7 @@ class DevicePrefetcher:
         if self._finished:
             raise StopIteration
         item = self.queue.get()
-        if isinstance(item, _Terminal):
+        if isinstance(item, Terminal):
             self._finished = True
             if item.error is not None:
                 raise item.error

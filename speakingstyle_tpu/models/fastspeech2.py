@@ -158,6 +158,9 @@ class FastSpeech2(nn.Module):
             postnet_in = jnp.where(postnet_keep[None, :, None], mel_out, 0.0)
         postnet_residual = PostNet(
             n_mel_channels=self.config.preprocess.preprocessing.mel.n_mel_channels,
+            embedding_dim=cfg.postnet_embedding_dim,
+            kernel_size=cfg.postnet_kernel_size,
+            n_convolutions=cfg.postnet_layers,
             conv_impl=conv_impl,
             dtype=dtype,
             dropout_impl=cfg.dropout_impl,

@@ -1,0 +1,25 @@
+"""TPU-native text->wav serving: AOT shape-bucket lattice + continuous
+batching (see ARCHITECTURE.md "Serving").
+
+Layering:
+  lattice.py  — the (batch, L_src, T_mel) bucket grid + covering lookup
+  engine.py   — AOT precompile (donated buffers) + padded dispatch
+  batcher.py  — admission queue, deadline coalescing, per-request futures
+  server.py   — stdlib HTTP front-end (POST /synthesize, GET /healthz)
+"""
+
+from speakingstyle_tpu.serving.batcher import (  # noqa: F401
+    ContinuousBatcher,
+    ShutdownError,
+)
+from speakingstyle_tpu.serving.engine import (  # noqa: F401
+    CompileMonitor,
+    SynthesisEngine,
+    SynthesisRequest,
+    SynthesisResult,
+)
+from speakingstyle_tpu.serving.lattice import (  # noqa: F401
+    Bucket,
+    BucketLattice,
+    RequestTooLarge,
+)

@@ -1,0 +1,211 @@
+"""Continuous batcher: per-request futures over one device dispatch thread.
+
+Admission is a bounded ``queue.Queue`` of pending requests; a single
+background worker coalesces whatever is queued into the smallest covering
+lattice bucket and runs it as one engine dispatch, then scatters results
+back to per-request ``concurrent.futures.Future``s. The coalescing rule:
+
+  * the worker blocks until at least one request is pending;
+  * it then keeps admitting until EITHER the oldest pending request's
+    deadline (``arrival + max_wait``) expires OR a full
+    ``lattice.max_batch`` has coalesced — whichever comes first;
+  * while a dispatch executes on device, new arrivals queue up and form
+    the next batch (continuous batching — the device never waits on a
+    fixed batch boundary).
+
+Shutdown reuses the DevicePrefetcher discipline (data/prefetch.py):
+producers only ever enqueue through a stop-aware ``bounded_put``, and
+``close()`` enqueues exactly one ``Terminal`` item, so the worker drains
+every admitted request (flush), resolves each future exactly once, and
+exits; submits racing a close either land before the Terminal (and are
+flushed) or fail fast with ``ShutdownError``. A worker crash fails all
+in-flight futures rather than stranding their waiters.
+"""
+
+import queue
+import threading
+import time
+from collections import Counter
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from speakingstyle_tpu.data.prefetch import Terminal, bounded_put
+from speakingstyle_tpu.serving.engine import SynthesisEngine, SynthesisRequest
+
+
+class ShutdownError(RuntimeError):
+    """The batcher is closed (or closing) and cannot admit the request."""
+
+
+@dataclass
+class _Pending:
+    request: SynthesisRequest
+    future: Future
+    deadline: float  # monotonic instant the request must dispatch by
+
+
+class ContinuousBatcher:
+    """Single-dispatch-thread continuous batcher over a SynthesisEngine."""
+
+    def __init__(
+        self,
+        engine: SynthesisEngine,
+        max_wait: Optional[float] = None,   # seconds; default serve.max_wait_ms
+        max_batch: Optional[int] = None,    # default lattice.max_batch
+        queue_depth: Optional[int] = None,  # default serve.queue_depth
+    ):
+        serve = engine.cfg.serve
+        self.engine = engine
+        self.max_wait = (
+            serve.max_wait_ms / 1e3 if max_wait is None else max_wait
+        )
+        self.max_batch = max_batch or engine.lattice.max_batch
+        self._queue: "queue.Queue" = queue.Queue(
+            maxsize=queue_depth or serve.queue_depth
+        )
+        self._stopped = threading.Event()
+        self._closed_lock = threading.Lock()
+        self._terminal_sent = False
+        # observability (read by bench.py --serve and /healthz)
+        self.occupancy: Counter = Counter()   # real rows -> dispatch count
+        self.bucket_counts: Counter = Counter()
+        self.dispatched = 0
+        self.rejected = 0
+        self.thread = threading.Thread(
+            target=self._worker, name="serve-dispatch", daemon=True
+        )
+        self.thread.start()
+
+    # -- producer side ------------------------------------------------------
+
+    def submit(self, request: SynthesisRequest) -> Future:
+        """Admit a request; returns a Future resolving to SynthesisResult.
+
+        Validates geometry now (RequestTooLarge at submit, not mid-batch),
+        blocks stop-aware while the queue is full, and raises
+        ShutdownError once the batcher is closed.
+        """
+        if self._stopped.is_set():
+            raise ShutdownError("batcher is closed")
+        self.engine.admit(request)  # raises RequestTooLarge early
+        fut: Future = Future()
+        item = _Pending(
+            request=request,
+            future=fut,
+            deadline=time.monotonic() + self.max_wait,
+        )
+        if not bounded_put(self._queue, item, self._stopped):
+            self.rejected += 1
+            raise ShutdownError("batcher closed while request was queued")
+        return fut
+
+    # -- worker side --------------------------------------------------------
+
+    def _collect(self) -> Tuple[List[_Pending], bool]:
+        """Block for the first pending item, then coalesce: greedily drain
+        everything already queued (the backlog built up while the previous
+        dispatch ran — the continuous-batching case), then, if the batch
+        is still short of max_batch AND the oldest request's deadline has
+        not expired, keep waiting for arrivals until it does. Returns
+        (batch, saw_terminal)."""
+        first = self._queue.get()
+        if isinstance(first, Terminal):
+            return [], True
+        batch = [first]
+        while len(batch) < self.max_batch:
+            wait = first.deadline - time.monotonic()
+            try:
+                # greedy while a backlog exists; timed once it drains
+                item = (self._queue.get_nowait() if wait <= 0
+                        else self._queue.get(timeout=wait))
+            except queue.Empty:
+                break
+            if isinstance(item, Terminal):
+                return batch, True
+            batch.append(item)
+        return batch, False
+
+    def _dispatch(self, batch: List[_Pending]) -> None:
+        try:
+            results = self.engine.run([p.request for p in batch])
+        except BaseException as e:
+            for p in batch:
+                p.future.set_exception(e)
+            return
+        self.dispatched += 1
+        self.occupancy[len(batch)] += 1
+        bucket = getattr(results[0], "bucket", None) if results else None
+        if bucket is not None:
+            self.bucket_counts[bucket] += 1
+        for p, r in zip(batch, results):
+            p.future.set_result(r)
+
+    def _worker(self) -> None:
+        try:
+            while True:
+                batch, terminal = self._collect()
+                if batch:
+                    self._dispatch(batch)
+                if terminal:
+                    return
+        except BaseException as e:  # engine errors are caught per-batch;
+            # anything here is a harness bug — fail every waiter loudly
+            # rather than stranding them, then re-raise for visibility
+            self._fail_pending(e)
+            raise
+
+    def _fail_pending(self, error: BaseException) -> None:
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if not isinstance(item, Terminal):
+                item.future.set_exception(
+                    ShutdownError(f"dispatch worker died: {error!r}")
+                )
+
+    # -- shutdown -----------------------------------------------------------
+
+    def close(self, flush: bool = True, timeout: float = 30.0) -> None:
+        """Idempotent shutdown. ``flush=True`` (default) lets the worker
+        drain every admitted request before exiting; ``flush=False``
+        fails queued-but-undispatched requests with ShutdownError."""
+        with self._closed_lock:
+            first_close = not self._terminal_sent
+            self._terminal_sent = True
+        if first_close:
+            if not flush:
+                self._stopped.set()  # reject new submits immediately
+                self._fail_pending(ShutdownError("batcher closed"))
+            # exactly ONE terminal item ends the stream (prefetch
+            # discipline); plain blocking put — the worker is draining,
+            # and the queue has capacity again once it does
+            while self.thread.is_alive():
+                try:
+                    self._queue.put(Terminal(), timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+        self.thread.join(timeout=timeout)
+        self._stopped.set()
+        if self.thread.is_alive():
+            # join timed out mid-dispatch: the worker still owns the
+            # stream and will drain to the Terminal when it unblocks
+            return
+        # The worker is gone; requests that raced past the Terminal would
+        # hang forever. A bounded_put attempt already in flight when the
+        # stop flag went up can still land within one poll window
+        # (0.05 s) — drain, wait out that window, drain once more; no new
+        # item can appear after that (every later attempt sees the flag).
+        self._fail_pending(ShutdownError("batcher closed"))
+        time.sleep(0.06)
+        self._fail_pending(ShutdownError("batcher closed"))
+
+    def __enter__(self) -> "ContinuousBatcher":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

@@ -1,0 +1,408 @@
+"""AOT-precompiled synthesis engine: padded text batches -> mel -> wav.
+
+The serving counterpart of the training step: at construction the engine
+``jax.jit(...).lower(...).compile()``s the free-running acoustic model
+(FastSpeech2 + length-regulator free-run) for every lattice point and the
+HiFi-GAN generator for every ``(batch, T_mel)`` pair, with the padded
+request buffers donated. Steady-state dispatch then only ever calls the
+stored ``Compiled`` executables — which hard-error on a shape mismatch
+rather than retrace — so the serve loop structurally cannot compile.
+
+Two compile counters back that claim up:
+
+  * ``engine.compile_count`` — incremented by the engine itself around
+    each ``.compile()``;
+  * ``CompileMonitor`` — a ``jax.monitoring`` listener on the backend's
+    own ``/jax/core/compile/backend_compile_duration`` event, which
+    catches compiles the engine *didn't* perform (a stray ``jnp`` call on
+    a novel shape in the dispatch path, say). The serve smoke test and
+    ``bench.py --serve`` assert it reads zero after warmup.
+"""
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.serving.lattice import Bucket, BucketLattice, RequestTooLarge
+from speakingstyle_tpu.training.resilience import retry_io
+
+Control = Union[float, np.ndarray]  # scalar, or per-phoneme [src_len] array
+
+
+@dataclass
+class SynthesisRequest:
+    """One admitted utterance, fully host-side preprocessed (G2P done)."""
+
+    id: str
+    sequence: np.ndarray          # [src_len] int32 phoneme ids
+    ref_mel: np.ndarray           # [ref_len, n_mels] float32 style reference
+    speaker: int = 0
+    raw_text: str = ""
+    p_control: Control = 1.0
+    e_control: Control = 1.0
+    d_control: Control = 1.0
+    arrival: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class SynthesisResult:
+    """Per-request slice of one padded dispatch."""
+
+    id: str
+    raw_text: str
+    mel: np.ndarray               # [mel_len, n_mels] float32 (postnet mel)
+    mel_len: int
+    wav: Optional[np.ndarray]     # [mel_len * hop] int16, None w/o vocoder
+    durations: np.ndarray         # [src_len] int32 predicted frame counts
+    pitch_prediction: np.ndarray
+    energy_prediction: np.ndarray
+    src_len: int
+    bucket: Bucket
+    batch_rows: int               # real rows in the dispatch that served this
+
+
+class CompileMonitor:
+    """Counts backend compiles via the jax.monitoring event bus.
+
+    jax has no unregister API, so one module-level listener is installed
+    lazily and individual monitors subscribe to it; ``with monitor:``
+    scopes the counting window.
+    """
+
+    _lock = threading.Lock()
+    _active: List["CompileMonitor"] = []
+    _installed = False
+
+    def __init__(self):
+        self.count = 0
+
+    @classmethod
+    def _listener(cls, name: str, *args, **kwargs):
+        if "/jax/core/compile/backend_compile" in name:
+            with cls._lock:
+                for m in cls._active:
+                    m.count += 1
+
+    def __enter__(self) -> "CompileMonitor":
+        import jax.monitoring
+
+        with CompileMonitor._lock:
+            if not CompileMonitor._installed:
+                jax.monitoring.register_event_duration_secs_listener(
+                    CompileMonitor._listener
+                )
+                CompileMonitor._installed = True
+            CompileMonitor._active.append(self)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        with CompileMonitor._lock:
+            CompileMonitor._active.remove(self)
+        return False
+
+
+@contextlib.contextmanager
+def _quiet_donation():
+    """CPU (and the int32 length vectors on any backend) cannot always
+    honor donation; jax warns per lowering. The donation here is
+    best-effort by design — silence exactly that warning."""
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        yield
+
+
+def _fill_control(rows: List[Control], B: int, L: int) -> np.ndarray:
+    """Per-request controls -> one padded [B, L] float32 array (padding
+    rows/positions get the neutral 1.0; they are masked downstream)."""
+    out = np.ones((B, L), np.float32)
+    for i, c in enumerate(rows):
+        if np.isscalar(c):
+            out[i] = float(c)
+        else:
+            arr = np.asarray(c, np.float32)
+            out[i, : arr.shape[0]] = arr
+    return out
+
+
+class SynthesisEngine:
+    """Owns the model variables, the lattice, and the compiled programs."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        variables: Dict,
+        vocoder: Optional[Tuple] = None,   # (generator, params) or None
+        lattice: Optional[BucketLattice] = None,
+        model=None,
+    ):
+        from speakingstyle_tpu.models.factory import build_model
+
+        self.cfg = cfg
+        self.lattice = lattice or BucketLattice.from_config(cfg.serve)
+        # the sinusoid position tables are build-time constants (not
+        # params), so sizing them to the lattice is checkpoint-safe
+        n_position = max(
+            self.lattice.max_mel, self.lattice.max_src, cfg.model.max_seq_len
+        ) + 1
+        self.model = model if model is not None else build_model(
+            cfg, n_position=n_position
+        )
+        self.variables = variables
+        self.vocoder = vocoder
+        pp = cfg.preprocess.preprocessing
+        self.n_mels = pp.mel.n_mel_channels
+        self.max_wav_value = pp.audio.max_wav_value
+        self._pitch_axis = (
+            "src" if pp.pitch.feature == "phoneme_level" else "mel"
+        )
+        self._energy_axis = (
+            "src" if pp.energy.feature == "phoneme_level" else "mel"
+        )
+        self.compile_count = 0
+        self.dispatch_count = 0
+        self._acoustic: Dict[Bucket, object] = {}
+        self._vocoder_exe: Dict[Tuple[int, int], object] = {}
+        self._lock = threading.Lock()  # compile-on-miss exclusion
+
+    # -- compilation --------------------------------------------------------
+
+    def _acoustic_fn(self, t_mel: int):
+        def fn(variables, speakers, texts, src_lens, mels, mel_lens,
+               p_control, e_control, d_control):
+            out = self.model.apply(
+                variables,
+                speakers=speakers,
+                texts=texts,
+                src_lens=src_lens,
+                mels=mels,
+                mel_lens=mel_lens,
+                max_mel_len=t_mel,
+                p_control=p_control,
+                e_control=e_control,
+                d_control=d_control,
+                deterministic=True,
+            )
+            keep = ("mel_postnet", "mel_lens", "durations",
+                    "pitch_prediction", "energy_prediction")
+            return {k: out[k] for k in keep}
+        return fn
+
+    def _ctl_len(self, axis: str, bucket: Bucket) -> int:
+        return bucket.l_src if axis == "src" else bucket.t_mel
+
+    def precompile(self) -> float:
+        """AOT-compile every lattice point; returns wall seconds spent.
+
+        This function is the sanctioned home for compile-in-a-loop — the
+        JL008 lint rule exempts ``precompile``/``warmup``-named functions
+        for exactly this startup pattern.
+        """
+        t0 = time.monotonic()
+        for bucket in self.lattice.points():
+            self._compile_acoustic(bucket)
+        for b in self.lattice.batch_buckets:
+            for t in self.lattice.mel_buckets:
+                self._compile_vocoder(b, t)
+        return time.monotonic() - t0
+
+    def _compile_acoustic(self, bucket: Bucket):
+        import jax
+        import jax.numpy as jnp
+
+        b, l, t = bucket.b, bucket.l_src, bucket.t_mel
+        s = jax.ShapeDtypeStruct
+        args = (
+            self.variables,
+            s((b,), jnp.int32),                        # speakers
+            s((b, l), jnp.int32),                      # texts
+            s((b,), jnp.int32),                        # src_lens
+            s((b, t, self.n_mels), jnp.float32),       # ref mels
+            s((b,), jnp.int32),                        # mel_lens
+            s((b, self._ctl_len(self._pitch_axis, bucket)), jnp.float32),
+            s((b, self._ctl_len(self._energy_axis, bucket)), jnp.float32),
+            s((b, l), jnp.float32),                    # d_control
+        )
+        donate = tuple(range(1, 9)) if self.cfg.serve.donate_buffers else ()
+        jitted = jax.jit(self._acoustic_fn(t), donate_argnums=donate)
+        with _quiet_donation():
+            self._acoustic[bucket] = jitted.lower(*args).compile()
+        self.compile_count += 1
+
+    def _compile_vocoder(self, b: int, t: int):
+        import jax
+        import jax.numpy as jnp
+
+        if self.vocoder is None:
+            return
+        gen, params = self.vocoder
+
+        def fn(p, mels):
+            return gen.vocode(p, mels)
+
+        donate = (1,) if self.cfg.serve.donate_buffers else ()
+        jitted = jax.jit(fn, donate_argnums=donate)
+        with _quiet_donation():
+            self._vocoder_exe[(b, t)] = jitted.lower(
+                params, jax.ShapeDtypeStruct((b, t, self.n_mels), jnp.float32)
+            ).compile()
+        self.compile_count += 1
+
+    # -- admission geometry -------------------------------------------------
+
+    def required_mel(self, req: SynthesisRequest) -> int:
+        """The T_mel a request needs: covers its style-reference input and
+        a ``frames_per_phoneme``-bounded free-run output buffer (longer
+        predictions truncate, matching the reference's max_seq_len clamp)."""
+        est_out = len(req.sequence) * self.cfg.serve.frames_per_phoneme
+        return max(req.ref_mel.shape[0], est_out)
+
+    def cover(self, requests: List[SynthesisRequest]) -> Bucket:
+        return self.lattice.cover(
+            len(requests),
+            max(len(r.sequence) for r in requests),
+            max(self.required_mel(r) for r in requests),
+        )
+
+    def admit(self, req: SynthesisRequest) -> None:
+        """Raise RequestTooLarge now (at submit) rather than at dispatch,
+        where it would poison the whole coalesced batch."""
+        if req.sequence.ndim != 1 or req.ref_mel.ndim != 2:
+            raise ValueError(
+                f"request {req.id!r}: sequence must be [L] and ref_mel "
+                f"[T, n_mels], got {req.sequence.shape} / {req.ref_mel.shape}"
+            )
+        self.lattice.cover(1, len(req.sequence), self.required_mel(req))
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _transfer(self, arrays: Dict[str, np.ndarray]) -> Dict:
+        """Host->device with the DevicePrefetcher retry discipline."""
+        import jax
+
+        serve = self.cfg.serve
+
+        def put():
+            return {k: jax.device_put(v) for k, v in arrays.items()}
+
+        if not serve.transfer_retries:
+            return put()
+        return retry_io(
+            put,
+            retries=serve.transfer_retries,
+            backoff=serve.transfer_backoff,
+            exceptions=(OSError, jax.errors.JaxRuntimeError),
+            describe="serve device transfer",
+        )
+
+    def run(self, requests: List[SynthesisRequest]) -> List[SynthesisResult]:
+        """Pad ``requests`` into their smallest covering bucket, execute
+        the precompiled programs, and scatter per-request results.
+
+        Performs ZERO compiles when the bucket was precompiled; a lattice
+        miss (possible only if callers bypass ``admit``/``cover``)
+        compiles once under the engine lock and counts it.
+        """
+        if not requests:
+            return []
+        bucket = self.cover(requests)
+        with self._lock:
+            if bucket not in self._acoustic:
+                self._compile_acoustic(bucket)
+            if self.vocoder is not None and \
+                    (bucket.b, bucket.t_mel) not in self._vocoder_exe:
+                self._compile_vocoder(bucket.b, bucket.t_mel)
+        b, l, t = bucket.b, bucket.l_src, bucket.t_mel
+        n = len(requests)
+
+        speakers = np.zeros((b,), np.int32)
+        texts = np.zeros((b, l), np.int32)
+        src_lens = np.zeros((b,), np.int32)
+        mels = np.zeros((b, t, self.n_mels), np.float32)
+        mel_lens = np.zeros((b,), np.int32)
+        for i, r in enumerate(requests):
+            speakers[i] = r.speaker
+            texts[i, : len(r.sequence)] = r.sequence
+            src_lens[i] = len(r.sequence)
+            ref = r.ref_mel[:t]
+            mels[i, : ref.shape[0]] = ref
+            mel_lens[i] = ref.shape[0]
+        arrays = {
+            "speakers": speakers,
+            "texts": texts,
+            "src_lens": src_lens,
+            "mels": mels,
+            "mel_lens": mel_lens,
+            "p_control": _fill_control(
+                [r.p_control for r in requests], b,
+                self._ctl_len(self._pitch_axis, bucket)),
+            "e_control": _fill_control(
+                [r.e_control for r in requests], b,
+                self._ctl_len(self._energy_axis, bucket)),
+            "d_control": _fill_control(
+                [r.d_control for r in requests], b, l),
+        }
+        dev = self._transfer(arrays)
+        out = self._acoustic[bucket](
+            self.variables, dev["speakers"], dev["texts"], dev["src_lens"],
+            dev["mels"], dev["mel_lens"], dev["p_control"], dev["e_control"],
+            dev["d_control"],
+        )
+        mel_out = out["mel_postnet"]  # [b, t, n_mels] device array
+
+        wavs = None
+        hop = 1
+        if self.vocoder is not None:
+            gen, params = self.vocoder
+            hop = gen.hop_factor
+            # donation consumes mel_out on device — read the mel back
+            # BEFORE vocoding
+            mel_host = np.asarray(mel_out)
+            wav_dev = self._vocoder_exe[(bucket.b, t)](params, mel_out)
+            # one vectorized int16 conversion for the whole batch (the
+            # per-item numpy work is what bounds coalesced throughput on
+            # the CPU bench)
+            wavs = np.clip(
+                np.asarray(wav_dev) * self.max_wav_value,
+                -self.max_wav_value, self.max_wav_value - 1,
+            ).astype(np.int16)
+        else:
+            mel_host = np.asarray(mel_out)
+
+        out_mel_lens = np.asarray(out["mel_lens"])
+        durations = np.asarray(out["durations"])
+        pitch = np.asarray(out["pitch_prediction"])
+        energy = np.asarray(out["energy_prediction"])
+        self.dispatch_count += 1
+
+        results = []
+        for i, r in enumerate(requests):
+            mel_len = int(out_mel_lens[i])
+            src_len = int(src_lens[i])
+            wav = None
+            if wavs is not None:
+                wav = wavs[i, : mel_len * hop]
+            p_len = src_len if self._pitch_axis == "src" else mel_len
+            e_len = src_len if self._energy_axis == "src" else mel_len
+            results.append(SynthesisResult(
+                id=r.id,
+                raw_text=r.raw_text,
+                mel=mel_host[i, :mel_len],
+                mel_len=mel_len,
+                wav=wav,
+                durations=durations[i, :src_len],
+                pitch_prediction=pitch[i, :p_len],
+                energy_prediction=energy[i, :e_len],
+                src_len=src_len,
+                bucket=bucket,
+                batch_rows=n,
+            ))
+        return results

@@ -1,0 +1,108 @@
+"""The AOT shape-bucket lattice.
+
+Every served dispatch executes at a ``(batch, L_src, T_mel)`` shape drawn
+from a small cross product of per-axis buckets (configs.ServeConfig), all
+compiled ahead of time at server start — the serving analogue of the
+training side's ``bucket_length`` quantization (data/dataset.py), which
+keeps XLA at a handful of programs instead of one per request geometry.
+
+Because the lattice is a full cross product, the elementwise-smallest
+covering point exists and is unique: ``cover`` rounds each axis up
+independently, so "smallest covering bucket" needs no volume tie-breaks.
+"""
+
+import bisect
+from dataclasses import dataclass
+from typing import List, Sequence
+
+from speakingstyle_tpu.configs.config import ServeConfig
+
+
+class RequestTooLarge(ValueError):
+    """A request exceeds the lattice's largest bucket on some axis."""
+
+
+@dataclass(frozen=True, order=True)
+class Bucket:
+    """One lattice point: the padded dispatch shape."""
+
+    b: int       # batch rows
+    l_src: int   # padded phoneme-sequence length
+    t_mel: int   # padded mel length: reference-mel input AND free-run
+                 # output buffer (max_mel_len)
+
+    @property
+    def volume(self) -> int:
+        return self.b * self.l_src * self.t_mel
+
+
+def _cover_axis(values: Sequence[int], n: int, axis: str) -> int:
+    """Smallest bucket >= n on one (ascending) axis."""
+    i = bisect.bisect_left(values, n)
+    if i == len(values):
+        raise RequestTooLarge(
+            f"{axis}={n} exceeds the largest serve bucket {values[-1]}; "
+            f"enlarge serve.{axis}_buckets or reject the request upstream"
+        )
+    return values[i]
+
+
+class BucketLattice:
+    """The cross product of batch/src/mel buckets, plus covering lookup."""
+
+    def __init__(
+        self,
+        batch_buckets: Sequence[int],
+        src_buckets: Sequence[int],
+        mel_buckets: Sequence[int],
+    ):
+        for name, vals in (("batch", batch_buckets), ("src", src_buckets),
+                           ("mel", mel_buckets)):
+            if not vals or sorted(vals) != list(vals) or min(vals) <= 0:
+                raise ValueError(
+                    f"{name} buckets must be non-empty ascending positive, "
+                    f"got {list(vals)}"
+                )
+        self.batch_buckets = list(batch_buckets)
+        self.src_buckets = list(src_buckets)
+        self.mel_buckets = list(mel_buckets)
+
+    @classmethod
+    def from_config(cls, serve: ServeConfig) -> "BucketLattice":
+        return cls(serve.batch_buckets, serve.src_buckets, serve.mel_buckets)
+
+    @property
+    def max_batch(self) -> int:
+        return self.batch_buckets[-1]
+
+    @property
+    def max_src(self) -> int:
+        return self.src_buckets[-1]
+
+    @property
+    def max_mel(self) -> int:
+        return self.mel_buckets[-1]
+
+    def points(self) -> List[Bucket]:
+        """All lattice points, smallest volume first (compile order: the
+        cheap points come up first so a watchdog'd startup fails fast)."""
+        pts = [
+            Bucket(b, l, t)
+            for b in self.batch_buckets
+            for l in self.src_buckets
+            for t in self.mel_buckets
+        ]
+        return sorted(pts, key=lambda p: (p.volume, p))
+
+    def __len__(self) -> int:
+        return (len(self.batch_buckets) * len(self.src_buckets)
+                * len(self.mel_buckets))
+
+    def cover(self, n: int, l_src: int, t_mel: int) -> Bucket:
+        """The unique elementwise-smallest point covering the request
+        geometry; raises RequestTooLarge when some axis cannot cover."""
+        return Bucket(
+            _cover_axis(self.batch_buckets, n, "batch"),
+            _cover_axis(self.src_buckets, l_src, "src"),
+            _cover_axis(self.mel_buckets, t_mel, "mel"),
+        )

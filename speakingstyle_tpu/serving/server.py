@@ -1,0 +1,252 @@
+"""Stdlib HTTP front-end over the continuous batcher.
+
+``ThreadingHTTPServer`` gives one thread per connection; each handler
+thread does the host-side work (JSON parse, G2P, reference-mel lookup),
+submits a SynthesisRequest, and blocks on its future — so concurrent
+HTTP clients coalesce into shared device dispatches without any async
+framework. The handler never touches jax (JL008 enforces that compiles
+stay out of request handlers); all device work happens on the batcher's
+single dispatch thread against AOT-precompiled executables.
+
+API:
+  POST /synthesize   {"text": ..., "speaker_id"?, "pitch_control"?,
+                      "energy_control"?, "duration_control"?,
+                      "ref_audio"? (server-side wav path)}
+                     -> audio/wav (16-bit PCM)
+  GET  /healthz      -> JSON engine/batcher stats (compile counter,
+                        batch-occupancy histogram, lattice size)
+"""
+
+import concurrent.futures
+import json
+import os
+import struct
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+import numpy as np
+
+from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.serving.batcher import ContinuousBatcher, ShutdownError
+from speakingstyle_tpu.serving.engine import SynthesisEngine, SynthesisRequest
+from speakingstyle_tpu.serving.lattice import RequestTooLarge
+
+
+def wav_bytes(wav: np.ndarray, sampling_rate: int) -> bytes:
+    """int16 PCM -> a complete RIFF/WAVE file in memory (stdlib only)."""
+    data = np.asarray(wav, np.int16).tobytes()
+    hdr = b"RIFF" + struct.pack("<I", 36 + len(data)) + b"WAVE"
+    hdr += b"fmt " + struct.pack("<IHHIIHH", 16, 1, 1, sampling_rate,
+                                 sampling_rate * 2, 2, 16)
+    hdr += b"data" + struct.pack("<I", len(data))
+    return hdr + data
+
+
+class TextFrontend:
+    """Host-side request preparation: G2P + reference-mel cache."""
+
+    def __init__(self, cfg: Config, default_ref_mel: Optional[np.ndarray]):
+        self.cfg = cfg
+        self.default_ref_mel = default_ref_mel
+        self._mel_cache: Dict[str, np.ndarray] = {}
+        self._cache_lock = threading.Lock()
+        pp = cfg.preprocess
+        self.lexicon_path = pp.path.lexicon_path or None
+        speakers_path = os.path.join(
+            pp.path.preprocessed_path or "", "speakers.json"
+        )
+        self.speaker_map: Dict[str, int] = {}
+        if pp.path.preprocessed_path and os.path.exists(speakers_path):
+            with open(speakers_path) as f:
+                self.speaker_map = json.load(f)
+
+    def sequence(self, text: str) -> np.ndarray:
+        from speakingstyle_tpu.text.g2p import preprocess_text
+
+        t = self.cfg.preprocess.preprocessing.text
+        seq = preprocess_text(
+            text, t.language, self.lexicon_path, list(t.text_cleaners)
+        )
+        return np.asarray(seq, np.int32)
+
+    def speaker(self, spec) -> int:
+        if isinstance(spec, int):
+            return spec
+        s = str(spec)
+        if s in self.speaker_map:
+            return self.speaker_map[s]
+        if s.lstrip("-").isdigit():
+            return int(s)
+        raise ValueError(f"unknown speaker {spec!r}")
+
+    def ref_mel(self, path: Optional[str]) -> np.ndarray:
+        if path is None:
+            if self.default_ref_mel is None:
+                raise ValueError(
+                    "no reference mel: pass \"ref_audio\" (a server-side "
+                    "wav path) or start the server with --ref_audio"
+                )
+            return self.default_ref_mel
+        with self._cache_lock:
+            mel = self._mel_cache.get(path)
+        if mel is None:
+            mel = load_ref_mel(self.cfg, path)
+            with self._cache_lock:
+                self._mel_cache[path] = mel
+        return mel
+
+    def request(self, req_id: str, payload: Dict) -> SynthesisRequest:
+        text = payload.get("text")
+        if not text or not isinstance(text, str):
+            raise ValueError('payload must carry a non-empty "text" string')
+
+        def ctl(key):
+            v = payload.get(key, 1.0)
+            if isinstance(v, (int, float)):
+                return float(v)
+            raise ValueError(f"{key} must be a number (scalar control)")
+
+        return SynthesisRequest(
+            id=req_id,
+            sequence=self.sequence(text),
+            ref_mel=self.ref_mel(payload.get("ref_audio")),
+            speaker=self.speaker(payload.get("speaker_id", 0)),
+            raw_text=text,
+            p_control=ctl("pitch_control"),
+            e_control=ctl("energy_control"),
+            d_control=ctl("duration_control"),
+        )
+
+
+def load_ref_mel(cfg: Config, wav_path: str) -> np.ndarray:
+    """Reference wav -> [T, n_mels] normalized log-mel (CLI single-mode
+    pipeline, shared with cli/synthesize.py)."""
+    from speakingstyle_tpu.audio.stft import MelExtractor, get_mel_from_wav
+    from speakingstyle_tpu.audio.tools import load_wav
+
+    pp = cfg.preprocess.preprocessing
+    wav, _ = load_wav(wav_path, target_sr=pp.audio.sampling_rate)
+    mel, _ = get_mel_from_wav(
+        wav,
+        MelExtractor(
+            pp.stft.filter_length, pp.stft.hop_length, pp.stft.win_length,
+            pp.mel.n_mel_channels, pp.audio.sampling_rate,
+            pp.mel.mel_fmin, pp.mel.mel_fmax,
+        ),
+    )
+    return np.asarray(mel.T, np.float32)  # [T, n_mels]
+
+
+class SynthesisServer:
+    """Bind engine + batcher + frontend behind an HTTP socket."""
+
+    def __init__(
+        self,
+        engine: SynthesisEngine,
+        frontend: TextFrontend,
+        host: Optional[str] = None,
+        port: Optional[int] = None,
+        request_timeout: float = 60.0,
+    ):
+        serve = engine.cfg.serve
+        self.engine = engine
+        self.frontend = frontend
+        self.batcher = ContinuousBatcher(engine)
+        self.request_timeout = request_timeout
+        self.started = time.monotonic()
+        self._req_counter = 0
+        self._counter_lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # quiet the default per-request stderr line
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, code: int, obj: Dict):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path != "/healthz":
+                    return self._json(404, {"error": f"no route {self.path}"})
+                self._json(200, outer.stats())
+
+            def do_POST(self):
+                if self.path != "/synthesize":
+                    return self._json(404, {"error": f"no route {self.path}"})
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    result = outer.synthesize(payload)
+                except (ValueError, RequestTooLarge) as e:
+                    return self._json(400, {"error": str(e)})
+                except ShutdownError as e:
+                    return self._json(503, {"error": str(e)})
+                # concurrent.futures.TimeoutError only aliases the builtin
+                # from 3.11; catch both on 3.10
+                except (TimeoutError, concurrent.futures.TimeoutError):
+                    return self._json(504, {"error": "synthesis timed out"})
+                if result.wav is None:
+                    # vocoder-less engine: return the mel as JSON
+                    return self._json(200, {
+                        "id": result.id,
+                        "mel_len": result.mel_len,
+                        "mel": result.mel.tolist(),
+                    })
+                sr = outer.engine.cfg.preprocess.preprocessing.audio.sampling_rate
+                body = wav_bytes(result.wav, sr)
+                self.send_response(200)
+                self.send_header("Content-Type", "audio/wav")
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("X-Request-Id", result.id)
+                self.send_header("X-Batch-Rows", str(result.batch_rows))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(
+            (host if host is not None else serve.host,
+             port if port is not None else serve.port),
+            Handler,
+        )
+        self.httpd.daemon_threads = True
+
+    # -- request path (also used directly by tests) -------------------------
+
+    def synthesize(self, payload: Dict):
+        with self._counter_lock:
+            self._req_counter += 1
+            req_id = f"req{self._req_counter:08d}"
+        request = self.frontend.request(req_id, payload)
+        future = self.batcher.submit(request)
+        return future.result(timeout=self.request_timeout)
+
+    def stats(self) -> Dict:
+        return {
+            "uptime_s": round(time.monotonic() - self.started, 1),
+            "lattice_points": len(self.engine.lattice),
+            "compile_count": self.engine.compile_count,
+            "dispatches": self.engine.dispatch_count,
+            "batch_occupancy": dict(
+                sorted(self.batcher.occupancy.items())
+            ),
+            "requests": self._req_counter,
+        }
+
+    @property
+    def address(self):
+        return self.httpd.server_address
+
+    def serve_forever(self):
+        self.httpd.serve_forever()
+
+    def shutdown(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self.batcher.close()

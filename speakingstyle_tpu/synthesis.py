@@ -246,6 +246,52 @@ def _vocode(cfg: Config, vocoder, mels, lengths=None):
     return out
 
 
+def render_result(result, cfg: Config, path: str, plot: bool = False,
+                  vocoder=None) -> str:
+    """Write one serving ``SynthesisResult`` (serving/engine.py) to disk:
+    ``<path>/<id>.wav`` (+ ``<id>.png`` with ``plot``). Returns the wav
+    path.
+
+    The engine's neural-vocoder path arrives with ``result.wav`` already
+    rendered (int16, trimmed); a vocoder-less engine (``--griffin_lim``)
+    arrives with ``wav=None`` and is inverted host-side here. This is the
+    rendering half of the old ``synth_samples`` body, decoupled from the
+    padded Batch so the CLI and the server share the engine's
+    per-request results.
+    """
+    os.makedirs(path, exist_ok=True)
+    pp = cfg.preprocess.preprocessing
+    wav = result.wav
+    if wav is None:
+        # an untrained/degenerate prediction can be 0-1 frames long —
+        # below the istft minimum (griffin_lim reflect-pads one hop);
+        # write an empty (but valid) wav rather than crash the whole batch
+        wav = (np.zeros(0, np.int16) if result.mel_len < 2 else
+               _vocode(cfg, vocoder, result.mel[None], [result.mel_len])[0])
+
+    if plot and result.mel_len > 0:
+        pitch = _frame_level_overlay(
+            result.pitch_prediction, result.mel_len, result.durations,
+            pp.pitch.feature)
+        energy = _frame_level_overlay(
+            result.energy_prediction, result.mel_len, result.durations,
+            pp.energy.feature)
+        fig = plot_mel(
+            [(result.mel.T, pitch, energy)], load_denorm_stats(cfg),
+            ["Synthetized Spectrogram"],
+        )
+        fig.savefig(os.path.join(path, f"{result.id}.png"))
+        import matplotlib.pyplot as plt
+
+        plt.close(fig)
+
+    import scipy.io.wavfile
+
+    out = os.path.join(path, f"{result.id}.wav")
+    scipy.io.wavfile.write(out, pp.audio.sampling_rate, wav)
+    return out
+
+
 def synth_one_sample(batch, output, vocoder, cfg: Config):
     """First batch item: (fig, wav_reconstruction, wav_prediction, basename)
     for validation logging (reference: utils/tools.py:128-180)."""

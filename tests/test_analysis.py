@@ -377,6 +377,97 @@ def test_jl007_negative_outside_package():
 
 
 # ---------------------------------------------------------------------------
+# JL008 — compile in hot path
+# ---------------------------------------------------------------------------
+
+
+def test_jl008_positive_jit_in_loop():
+    assert "JL008" in _codes("""
+        import jax
+
+        def sweep(variants, x):
+            outs = []
+            for v in variants:
+                f = jax.jit(lambda y: y * v)
+                outs.append(f(x))
+            return outs
+    """)
+
+
+def test_jl008_positive_aot_chain_in_loop():
+    assert "JL008" in _codes("""
+        import jax
+
+        def build(fns, args):
+            return [jax.jit(f).lower(*args).compile() for f in fns]
+
+        def rebuild_each_step(fn, batches):
+            for b in batches:
+                exe = jax.jit(fn).lower(b).compile()
+                exe(b)
+    """)
+
+
+def test_jl008_positive_jit_in_request_handler():
+    # http.server-style do_POST and handle_* names are hot request paths
+    assert "JL008" in _codes("""
+        import jax
+
+        class Handler:
+            def do_POST(self):
+                f = jax.jit(self.model_fn)
+                return f(self.payload)
+    """)
+    assert "JL008" in _codes("""
+        import jax
+
+        def handle_synthesis(model_fn, payload):
+            return jax.jit(model_fn)(payload)
+    """)
+
+
+def test_jl008_negative_module_level_and_startup():
+    assert "JL008" not in _codes("""
+        import jax
+
+        step = jax.jit(lambda s, b: s + b)
+
+        def serve(batches):
+            for b in batches:
+                step(1, b)
+    """)
+
+
+def test_jl008_negative_precompile_function_exempt():
+    # the sanctioned AOT startup pattern (serving/engine.py)
+    assert "JL008" not in _codes("""
+        import jax
+
+        def precompile(fn, lattice):
+            exes = {}
+            for point in lattice:
+                exes[point] = jax.jit(fn).lower(point).compile()
+            return exes
+
+        def warmup_all(fn, shapes):
+            return [jax.jit(fn).lower(s).compile() for s in shapes]
+    """)
+
+
+def test_jl008_negative_re_compile_untouched():
+    # only the .lower().compile() AOT chain counts, not other .compile()s
+    assert "JL008" not in _codes("""
+        import re
+
+        def scan(lines, patterns):
+            for p in patterns:
+                rx = re.compile(p)
+                for ln in lines:
+                    rx.match(ln)
+    """)
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -488,7 +579,7 @@ def test_every_rule_is_non_vacuous():
     fired = {f.rule for f in linter.lint_paths()}
     fired |= {fp.split(":", 1)[0] for fp in linter.load_baseline()}
     for code in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
-                 "JL007"):
+                 "JL007", "JL008"):
         assert code in fired, f"{code} never fires on the real tree"
 
 
@@ -513,6 +604,8 @@ def test_cli_check_exits_zero_on_repo():
               "    b = jax.random.normal(rng, (2,))\n    return a + b\n"),
     ("JL007", "def f(p):\n    try:\n        return open(p).read()\n"
               "    except Exception:\n        pass\n"),
+    ("JL008", "import jax\n\ndef sweep(vs, x):\n    for v in vs:\n"
+              "        jax.jit(lambda y: y * v)(x)\n"),
 ])
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
     # JL004 is scoped to training/ paths; JL007 to speakingstyle_tpu/
