@@ -626,13 +626,6 @@ def _serve_engine(tiny: bool):
     return engine, label
 
 
-def _percentile(sorted_vals, q):
-    if not sorted_vals:
-        return None
-    i = min(int(len(sorted_vals) * q), len(sorted_vals) - 1)
-    return sorted_vals[i]
-
-
 def run_serve(duration: float = 3.0, clients=(1, 2, 4, 8, 16, 32)):
     """Offered-load sweep over the continuous-batching serve path.
 
@@ -641,11 +634,17 @@ def run_serve(duration: float = 3.0, clients=(1, 2, 4, 8, 16, 32)):
     the batch-occupancy histogram, and the compile counter — which MUST
     read zero after warmup (the acceptance invariant the smoke test also
     asserts). Finishes with the coalesced-vs-sequential speedup line.
+
+    Latency percentiles come straight out of the serving stack's own
+    ``serve_request_latency_seconds`` histogram (a fresh MetricsRegistry
+    per load point), NOT a bench-side raw-latency list: the bench reports
+    exactly what a /metrics scrape of the same traffic would.
     """
     import numpy as np
 
     import jax
 
+    from speakingstyle_tpu.obs import MetricsRegistry
     from speakingstyle_tpu.serving.batcher import ContinuousBatcher
     from speakingstyle_tpu.serving.engine import CompileMonitor, SynthesisRequest
 
@@ -700,27 +699,22 @@ def run_serve(duration: float = 3.0, clients=(1, 2, 4, 8, 16, 32)):
     best_qps = 0.0
     zero_compiles = True
     for n_clients in clients:
-        batcher = ContinuousBatcher(engine)
-        latencies = []
-        lat_lock = threading.Lock()
-        done_count = [0]
+        # a fresh registry per load point: its request-latency histogram
+        # and occupancy counters ARE this point's report
+        point = MetricsRegistry()
+        batcher = ContinuousBatcher(engine, registry=point)
         stop_at = time.perf_counter() + duration
 
         def client(cid: int):
             i = 0
             while time.perf_counter() < stop_at:
                 req = make_request(cid * 1_000_000 + i)
-                t0 = time.perf_counter()
                 try:
                     batcher.submit(req).result(timeout=60)
                 except Exception:
                     return
-                with lat_lock:
-                    latencies.append(time.perf_counter() - t0)
-                    done_count[0] += 1
                 i += 1
 
-        occupancy_before = dict(batcher.occupancy)
         with CompileMonitor() as mon:
             threads = [
                 threading.Thread(target=client, args=(c,), daemon=True)
@@ -733,25 +727,23 @@ def run_serve(duration: float = 3.0, clients=(1, 2, 4, 8, 16, 32)):
                 t.join()
             dt = time.perf_counter() - t0
             batcher.close()
-        occupancy = {
-            k: v - occupancy_before.get(k, 0)
-            for k, v in sorted(batcher.occupancy.items())
-        }
-        latencies.sort()
-        qps = done_count[0] / dt
+        hist = point.histogram("serve_request_latency_seconds")
+        qps = hist.count / dt
         best_qps = max(best_qps, qps)
         zero_compiles = zero_compiles and mon.count == 0
+
+        def pct_ms(q):
+            p = hist.percentile(q)
+            return round(1e3 * p, 1) if p is not None else None
+
         print(json.dumps({
             "metric": "serve_offered_load",
             "clients": n_clients,
             "qps": round(qps, 2),
-            "p50_ms": round(1e3 * _percentile(latencies, 0.50), 1)
-                      if latencies else None,
-            "p95_ms": round(1e3 * _percentile(latencies, 0.95), 1)
-                      if latencies else None,
-            "p99_ms": round(1e3 * _percentile(latencies, 0.99), 1)
-                      if latencies else None,
-            "batch_occupancy": occupancy,
+            "p50_ms": pct_ms(0.50),
+            "p95_ms": pct_ms(0.95),
+            "p99_ms": pct_ms(0.99),
+            "batch_occupancy": dict(sorted(batcher.occupancy.items())),
             "compiles_during_serve": mon.count,
             "model": label,
         }))

@@ -21,6 +21,9 @@ JL006  PRNG key reuse without split
 JL007  swallowed exceptions (broad except with no handling)
 JL008  XLA compilation in hot paths (jit/lower().compile() in loops or
        request handlers; precompile/warmup functions exempt)
+JL009  wall-clock time.time() used for duration measurement
+       (monotonic-clock rule: durations must use time.monotonic() or
+       time.perf_counter(); time.time() is for timestamps only)
 """
 
 import ast
@@ -1139,6 +1142,70 @@ def rule_jl008(mod: ModuleInfo) -> Iterator[Finding]:
         )
 
 
+# ---------------------------------------------------------------------------
+# JL009 — wall clock used for durations
+# ---------------------------------------------------------------------------
+
+
+def rule_jl009(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL009: ``time.time()`` used for duration measurement — a
+    wall-clock value (or a name assigned from one) appearing as an
+    operand of a subtraction.
+
+    ``time.time()`` follows the system clock: NTP slews/steps (and leap
+    smearing on cloud VMs) make wall-clock deltas lie, occasionally by
+    seconds — poison for latency histograms and throughput windows. Use
+    ``time.monotonic()`` (or ``time.perf_counter()``) for every
+    duration; wall time is for *timestamps* only (event-log ``ts``
+    fields), which are never subtracted.
+    """
+    wall = {"time.time"}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "time":
+                    wall.add(alias.asname or "time")
+
+    def is_wall_call(n: ast.AST) -> bool:
+        return isinstance(n, ast.Call) and _dotted(n.func) in wall
+
+    stamps: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and is_wall_call(node.value):
+            for t in node.targets:
+                for nm in ast.walk(t):
+                    if isinstance(nm, ast.Name):
+                        stamps.add(nm.id)
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.BinOp) or not isinstance(node.op, ast.Sub):
+            continue
+        hits = []
+        for side in (node.left, node.right):
+            if is_wall_call(side):
+                hits.append("time.time()")
+            elif isinstance(side, ast.Name) and side.id in stamps:
+                hits.append(side.id)
+        if not hits:
+            continue
+        fn = mod.enclosing_function(node)
+        qual = mod.qualname(fn or mod.tree)
+        yield Finding(
+            rule="JL009",
+            path=mod.path,
+            line=node.lineno,
+            context=qual,
+            detail=f"duration arithmetic on wall clock ({', '.join(hits)})",
+            message=(
+                f"wall-clock subtraction in {qual} ({', '.join(hits)}): "
+                "time.time() follows the (NTP-adjusted) system clock, so "
+                "deltas can jump or run backwards — measure durations with "
+                "time.monotonic()/time.perf_counter(); keep time.time() "
+                "for timestamps only."
+            ),
+        )
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -1148,4 +1215,5 @@ RULES = {
     "JL006": rule_jl006,
     "JL007": rule_jl007,
     "JL008": rule_jl008,
+    "JL009": rule_jl009,
 }

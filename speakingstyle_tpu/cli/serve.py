@@ -9,6 +9,11 @@ lattice (``serve.*`` config block), then serves:
                     -> audio/wav
   GET  /healthz     -> engine/batcher stats (compile counter must stay at
                        its post-startup value: steady state never compiles)
+  GET  /metrics     -> Prometheus text: the same registry snapshot
+                       (compile counters, queue depth, per-bucket dispatch
+                       latency histograms)
+  POST /debug/profile?seconds=N -> pull a jax.profiler trace from the
+                       live process (serve.debug_profile gates it)
 
 No reference counterpart: the reference's synthesize.py is one-shot and
 pays a fresh CUDA/compile warmup per invocation.
@@ -102,21 +107,34 @@ def main(args):
     default_ref = (
         load_ref_mel(cfg, args.ref_audio) if args.ref_audio else None
     )
+    events = None
+    if cfg.serve.log_events:
+        from speakingstyle_tpu.obs import JsonlEventLog
+
+        events = JsonlEventLog(
+            cfg.train.path.log_path,
+            max_bytes=cfg.train.obs.events_max_bytes,
+            keep=cfg.train.obs.events_keep,
+        )
     server = SynthesisServer(
         engine,
         TextFrontend(cfg, default_ref),
         host=args.host,
         port=args.port,
+        events=events,
     )
     host, port = server.address[:2]
     print(f"serving on http://{host}:{port} "
-          "(POST /synthesize, GET /healthz)", flush=True)
+          "(POST /synthesize, GET /healthz, GET /metrics, "
+          "POST /debug/profile?seconds=N)", flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
         print("shutting down (flushing admitted requests) ...", flush=True)
     finally:
         server.shutdown()
+        if events is not None:
+            events.close()
 
 
 if __name__ == "__main__":

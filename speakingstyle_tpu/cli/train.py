@@ -38,6 +38,12 @@ def build_parser(parser=None):
         help="write a jax.profiler trace of steps 10-20 here",
     )
     parser.add_argument(
+        "--profile_at", type=int, default=None,
+        help="capture a jax.profiler trace over steps [N, N+10) of this "
+        "run (relative to the resume point); the trace lands in "
+        "--profile_dir, defaulting to <train.path.log_path>/profile",
+    )
+    parser.add_argument(
         "--faults", type=str, default=None,
         help="deterministic fault-injection spec for resilience drills, "
         "e.g. 'nan_grads@120;sigterm@500' (sets SPEAKINGSTYLE_FAULTS; "
@@ -96,6 +102,14 @@ def main(args):
         from speakingstyle_tpu.synthesis import get_vocoder
 
         vocoder = get_vocoder(cfg, args.vocoder_ckpt)
+    profile_dir, profile_steps = args.profile_dir, (10, 20)
+    if args.profile_at is not None:
+        # --profile_at N: pull a trace from steps [N, N+10) without
+        # needing to pick a directory (the serve-side twin is
+        # POST /debug/profile)
+        profile_steps = (args.profile_at, args.profile_at + 10)
+        if profile_dir is None:
+            profile_dir = os.path.join(cfg.train.path.log_path, "profile")
     state = run_training(
         cfg,
         mesh=mesh,
@@ -103,7 +117,8 @@ def main(args):
         max_steps=args.max_steps,
         synth_callback="default" if args.synth else None,
         vocoder=vocoder,
-        profile_dir=args.profile_dir,
+        profile_dir=profile_dir,
+        profile_steps=profile_steps,
     )
     print(f"training finished at step {int(state.step)}")
 

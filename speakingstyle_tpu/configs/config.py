@@ -360,6 +360,28 @@ class ResilienceConfig:
 
 
 @dataclass(frozen=True)
+class ObsConfig:
+    """Telemetry knobs (speakingstyle_tpu/obs/ — ARCHITECTURE.md
+    "Observability"). The metrics registry itself is always on (it is
+    just in-memory counters); these control the export surfaces."""
+
+    # rotating JSONL event log under train.path.log_path (obs/events.py
+    # documents the schema; read it with `python -m speakingstyle_tpu.obs.cli`)
+    events: bool = True
+    # rotation: shift events.jsonl -> .1 past this size, keep N rotated files
+    events_max_bytes: int = 8_000_000
+    events_keep: int = 3
+
+    def __post_init__(self):
+        if self.events_max_bytes <= 0:
+            raise ValueError(
+                f"events_max_bytes must be > 0, got {self.events_max_bytes}"
+            )
+        if self.events_keep < 1:
+            raise ValueError(f"events_keep must be >= 1, got {self.events_keep}")
+
+
+@dataclass(frozen=True)
 class TrainConfig:
     path: TrainPathConfig = field(default_factory=TrainPathConfig)
     optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
@@ -367,6 +389,7 @@ class TrainConfig:
     loss: LossConfig = field(default_factory=LossConfig)
     sharding: ShardingConfig = field(default_factory=ShardingConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
     ignore_layers: List[str] = field(default_factory=list)
     seed: int = 1234
     # Use XLA's native RBG bit generator for dropout masks instead of
@@ -441,6 +464,13 @@ class ServeConfig:
     transfer_backoff: float = 0.05
     host: str = "127.0.0.1"
     port: int = 8400
+    # POST /debug/profile?seconds=N pulls a jax.profiler trace from the
+    # live server (written under <log_path>/serve_profile); disable on
+    # exposed deployments
+    debug_profile: bool = True
+    # emit serve_dispatch / http_request JSONL events (obs/events.py
+    # schema) under train.path.log_path — req_id joins the two streams
+    log_events: bool = False
 
     def __post_init__(self):
         for name in ("batch_buckets", "src_buckets", "mel_buckets"):

@@ -21,6 +21,7 @@ from typing import Iterator, Optional
 import jax
 
 from speakingstyle_tpu.data.dataset import Batch
+from speakingstyle_tpu.obs import MetricsRegistry, get_registry
 from speakingstyle_tpu.parallel.mesh import batch_sharding
 from speakingstyle_tpu.training.resilience import retry_io
 
@@ -67,12 +68,26 @@ class DevicePrefetcher:
         depth: int = 2,
         transfer_retries: int = 0,
         transfer_backoff: float = 0.05,
+        registry: Optional[MetricsRegistry] = None,
     ):
         self.batches = batches
         self.sharding = batch_sharding(mesh) if mesh is not None else None
         self.queue: "queue.Queue" = queue.Queue(maxsize=depth)
         self.transfer_retries = transfer_retries
         self.transfer_backoff = transfer_backoff
+        # queue occupancy is THE data-pipeline health signal: pinned at
+        # `depth` means the device is the bottleneck (good); at 0 the
+        # step loop is starving on data (the data-wait split in the
+        # trainer says how badly)
+        self.registry = registry if registry is not None else get_registry()
+        self._depth_gauge = self.registry.gauge(
+            "data_prefetch_queue_depth",
+            help="prefetch queue occupancy (0 = step loop is data-starved)",
+        )
+        self._batches_ctr = self.registry.counter(
+            "data_prefetch_batches_total",
+            help="batches handed to the step loop",
+        )
         self._stopped = threading.Event()
         self._finished = False
         self.thread = threading.Thread(target=self._worker, daemon=True)
@@ -114,7 +129,10 @@ class DevicePrefetcher:
 
     def _bounded_put(self, item) -> bool:
         """Stop-aware bounded put (see module-level ``bounded_put``)."""
-        return bounded_put(self.queue, item, self._stopped)
+        ok = bounded_put(self.queue, item, self._stopped)
+        if ok:
+            self._depth_gauge.set(self.queue.qsize())
+        return ok
 
     def _worker(self):
         terminal = Terminal()
@@ -135,11 +153,13 @@ class DevicePrefetcher:
         if self._finished:
             raise StopIteration
         item = self.queue.get()
+        self._depth_gauge.set(self.queue.qsize())
         if isinstance(item, Terminal):
             self._finished = True
             if item.error is not None:
                 raise item.error
             raise StopIteration
+        self._batches_ctr.inc()
         return item
 
     def stop(self):

@@ -1,0 +1,43 @@
+"""Unified telemetry: metrics registry, trace spans, JSONL events.
+
+The single instrumented spine shared by training, data, and serving
+(ARCHITECTURE.md "Observability"):
+
+  * ``registry`` — thread-safe counters/gauges/bounded-bucket histograms
+    with p50/p95/p99 estimates; ``snapshot()`` (dict) and
+    ``prometheus_text()`` (``GET /metrics``) export surfaces;
+  * ``events`` — rotating JSONL event log with a stable documented
+    schema (the training run's structured record);
+  * ``trace`` — lightweight monotonic-clock spans feeding both;
+  * ``jaxmon`` — the jax.monitoring bridge (backend compile counter +
+    scoped ``CompileMonitor`` windows).
+
+Zero dependencies, no jax import at module scope.
+"""
+
+from speakingstyle_tpu.obs.events import JsonlEventLog, read_events
+from speakingstyle_tpu.obs.jaxmon import CompileMonitor, watch_compiles
+from speakingstyle_tpu.obs.registry import (
+    DEFAULT_TIME_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+)
+from speakingstyle_tpu.obs.trace import Span, span
+
+__all__ = [
+    "Counter",
+    "CompileMonitor",
+    "DEFAULT_TIME_BUCKETS",
+    "Gauge",
+    "Histogram",
+    "JsonlEventLog",
+    "MetricsRegistry",
+    "Span",
+    "get_registry",
+    "read_events",
+    "span",
+    "watch_compiles",
+]

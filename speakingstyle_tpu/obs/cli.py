@@ -1,0 +1,88 @@
+"""``python -m speakingstyle_tpu.obs.cli <log_dir-or-events.jsonl>``
+
+Summarize (or filter) a run's JSONL event log (obs/events.py schema):
+
+  default        per-event-type counts + the training progress tail
+                 (last step, last losses, mean step-time / data-wait)
+  --event NAME   dump matching records as JSONL to stdout (jq-friendly)
+  --tail N       dump the last N records as JSONL
+
+No jax import — safe to run on a login node against a live run's logs.
+"""
+
+import argparse
+import collections
+import json
+import sys
+
+from speakingstyle_tpu.obs.events import read_events
+
+
+def build_parser(parser=None):
+    parser = parser or argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "path", help="train.path.log_path directory or an events.jsonl file"
+    )
+    parser.add_argument(
+        "--event", default=None,
+        help="dump records of this event type as JSONL instead of summarizing",
+    )
+    parser.add_argument(
+        "--tail", type=int, default=None,
+        help="dump the last N records as JSONL instead of summarizing",
+    )
+    return parser
+
+
+def summarize(path, out=sys.stdout):
+    counts = collections.Counter()
+    last_train = None
+    step_time_sum = data_wait_sum = 0.0
+    n_train = 0
+    for rec in read_events(path):
+        counts[rec.get("event", "?")] += 1
+        if rec.get("event") == "train_step":
+            last_train = rec
+            n_train += 1
+            step_time_sum += rec.get("step_time_s") or 0.0
+            data_wait_sum += rec.get("data_wait_s") or 0.0
+    if not counts:
+        print(f"no events found under {path}", file=out)
+        return 1
+    print("events:", file=out)
+    for name, n in counts.most_common():
+        print(f"  {name:20s} {n}", file=out)
+    if last_train is not None:
+        losses = {
+            k: v for k, v in last_train.items()
+            if isinstance(v, (int, float)) and k.endswith("loss")
+        }
+        print(f"last train_step: step={last_train.get('step')}", file=out)
+        for k, v in sorted(losses.items()):
+            print(f"  {k:20s} {v:.4f}", file=out)
+        if n_train:
+            print(
+                f"mean step_time_s={step_time_sum / n_train:.4f} "
+                f"data_wait_s={data_wait_sum / n_train:.4f} "
+                f"(over {n_train} logged windows)",
+                file=out,
+            )
+    return 0
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    if args.event is not None:
+        for rec in read_events(args.path, event=args.event):
+            print(json.dumps(rec))
+        return 0
+    if args.tail is not None:
+        records = list(read_events(args.path))
+        for rec in records[-args.tail:]:
+            print(json.dumps(rec))
+        return 0
+    return summarize(args.path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,93 @@
+"""jax.monitoring bridge: backend events folded into metrics registries.
+
+jax's monitoring bus has no unregister API, so exactly ONE module-level
+listener is ever installed; everything downstream subscribes to it:
+
+  * ``watch_compiles(registry)`` — every backend compile event
+    increments ``jax_backend_compiles_total`` in that registry (each
+    ``SynthesisEngine`` subscribes its own, so ``/metrics`` exports the
+    backend's own compile count next to the engine's ``.compile()``
+    bookkeeping — two independent witnesses for the zero-steady-state-
+    compiles invariant);
+  * ``CompileMonitor`` — a scoped counting window (``with monitor:``),
+    used by the serve smoke test and ``bench.py --serve`` to assert the
+    count is zero across a traffic window.
+
+jax is imported lazily (on first install), so this module — like the
+rest of ``obs/`` — costs nothing to import in jax-free contexts
+(jaxlint, the events CLI).
+"""
+
+import threading
+from typing import List
+
+from speakingstyle_tpu.obs.registry import MetricsRegistry
+
+_COMPILE_EVENT = "/jax/core/compile/backend_compile"
+
+_lock = threading.Lock()
+_installed = False
+_registries: List[MetricsRegistry] = []
+_active_monitors: List["CompileMonitor"] = []
+
+
+def _listener(name: str, *args, **kwargs) -> None:
+    if _COMPILE_EVENT not in name:
+        return
+    with _lock:
+        regs = list(_registries)
+        mons = list(_active_monitors)
+    for r in regs:
+        r.counter(
+            "jax_backend_compiles_total",
+            help="XLA backend compiles observed on the jax.monitoring bus",
+        ).inc()
+    for m in mons:
+        m._bump()
+
+
+def _ensure_installed() -> None:
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        import jax.monitoring
+
+        jax.monitoring.register_event_duration_secs_listener(_listener)
+        _installed = True
+
+
+def watch_compiles(registry: MetricsRegistry) -> None:
+    """Subscribe ``registry`` to backend compile events (idempotent)."""
+    _ensure_installed()
+    # touch the counter so /metrics exports 0 before the first compile
+    registry.counter(
+        "jax_backend_compiles_total",
+        help="XLA backend compiles observed on the jax.monitoring bus",
+    )
+    with _lock:
+        if not any(r is registry for r in _registries):
+            _registries.append(registry)
+
+
+class CompileMonitor:
+    """Scoped backend-compile counter (``with monitor: ... monitor.count``)."""
+
+    def __init__(self):
+        self.count = 0
+        self._mlock = threading.Lock()
+
+    def _bump(self) -> None:
+        with self._mlock:
+            self.count += 1
+
+    def __enter__(self) -> "CompileMonitor":
+        _ensure_installed()
+        with _lock:
+            _active_monitors.append(self)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        with _lock:
+            _active_monitors.remove(self)
+        return False

@@ -1,0 +1,85 @@
+"""Lightweight trace spans over the metrics registry + event log.
+
+A ``Span`` measures one monotonic-clock duration and fans it out to the
+telemetry surfaces: a registry histogram (named ``<name>_seconds`` by
+default, optionally labeled) and, when an event log is attached, one
+JSONL record carrying the span's fields — including ``req_id``-style
+join keys, which is how one serving request's handler, batcher, and
+engine records line up end-to-end.
+
+This is deliberately not a distributed-tracing system: no context
+propagation, no sampling — just a cheap, explicit timing primitive for
+the repo's three hot paths. For device-side timing use
+``jax.profiler.StepTraceAnnotation`` (the train loop does) or the
+on-demand profile capture hooks (``POST /debug/profile`` on serve,
+``--profile_at`` on train).
+"""
+
+import time
+from typing import Dict, Mapping, Optional
+
+from speakingstyle_tpu.obs.events import JsonlEventLog
+from speakingstyle_tpu.obs.registry import (
+    DEFAULT_TIME_BUCKETS,
+    MetricsRegistry,
+)
+
+
+class Span:
+    """Context manager timing one operation.
+
+    ``fields`` ride into the JSONL record verbatim (and can be extended
+    mid-span via ``span.note(k=v)``); ``labels`` select the histogram
+    child. On exception the event records ``ok: false`` and the error
+    type; the duration is still observed.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        registry: Optional[MetricsRegistry] = None,
+        events: Optional[JsonlEventLog] = None,
+        histogram: Optional[str] = None,
+        labels: Optional[Mapping[str, str]] = None,
+        edges=DEFAULT_TIME_BUCKETS,
+        **fields,
+    ):
+        self.name = name
+        self.registry = registry
+        self.events = events
+        self.histogram = histogram or f"{name}_seconds"
+        self.labels = labels
+        self.edges = edges
+        self.fields: Dict = dict(fields)
+        self.duration_s: Optional[float] = None
+        self._t0: Optional[float] = None
+
+    def note(self, **fields) -> "Span":
+        self.fields.update(fields)
+        return self
+
+    def __enter__(self) -> "Span":
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.duration_s = time.monotonic() - self._t0
+        if self.registry is not None:
+            self.registry.histogram(
+                self.histogram, edges=self.edges, labels=self.labels
+            ).observe(self.duration_s)
+        if self.events is not None:
+            rec = dict(self.fields)
+            rec["duration_s"] = self.duration_s
+            if self.labels:
+                rec.update(self.labels)
+            if exc_type is not None:
+                rec["ok"] = False
+                rec["error"] = exc_type.__name__
+            self.events.emit(self.name, **rec)
+        return False
+
+
+def span(name: str, **kw) -> Span:
+    """Sugar: ``with span("serve_dispatch", registry=reg, rows=4): ...``"""
+    return Span(name, **kw)

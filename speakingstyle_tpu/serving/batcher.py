@@ -31,7 +31,12 @@ from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
 from speakingstyle_tpu.data.prefetch import Terminal, bounded_put
-from speakingstyle_tpu.serving.engine import SynthesisEngine, SynthesisRequest
+from speakingstyle_tpu.obs import JsonlEventLog, MetricsRegistry
+from speakingstyle_tpu.serving.engine import (
+    SynthesisEngine,
+    SynthesisRequest,
+    bucket_label,
+)
 
 
 class ShutdownError(RuntimeError):
@@ -54,6 +59,8 @@ class ContinuousBatcher:
         max_wait: Optional[float] = None,   # seconds; default serve.max_wait_ms
         max_batch: Optional[int] = None,    # default lattice.max_batch
         queue_depth: Optional[int] = None,  # default serve.queue_depth
+        registry: Optional[MetricsRegistry] = None,  # default engine.registry
+        events: Optional[JsonlEventLog] = None,
     ):
         serve = engine.cfg.serve
         self.engine = engine
@@ -67,15 +74,63 @@ class ContinuousBatcher:
         self._stopped = threading.Event()
         self._closed_lock = threading.Lock()
         self._terminal_sent = False
-        # observability (read by bench.py --serve and /healthz)
-        self.occupancy: Counter = Counter()   # real rows -> dispatch count
-        self.bucket_counts: Counter = Counter()
-        self.dispatched = 0
-        self.rejected = 0
+        # observability: everything lives in the registry (obs/), which
+        # /metrics, /healthz, and bench.py all read from one snapshot —
+        # occupancy/dispatched/rejected below are VIEWS of it, not
+        # parallel counters
+        # engines are duck-typed in tests; fall back to a private registry
+        self.registry = (
+            registry if registry is not None
+            else getattr(engine, "registry", None) or MetricsRegistry()
+        )
+        self.events = events
+        self._queue_gauge = self.registry.gauge(
+            "serve_queue_depth", help="admission queue occupancy (pending)"
+        )
+        self._batches = self.registry.counter(
+            "serve_batches_total", help="coalesced batches dispatched"
+        )
+        self._rejected_ctr = self.registry.counter(
+            "serve_rejected_total", help="submits refused at/after shutdown"
+        )
+        self._latency_hist = self.registry.histogram(
+            "serve_request_latency_seconds",
+            help="request arrival -> result latency through the batcher",
+        )
         self.thread = threading.Thread(
             target=self._worker, name="serve-dispatch", daemon=True
         )
         self.thread.start()
+
+    # -- registry views (the pre-obs attribute API, minus the bookkeeping) --
+
+    @property
+    def occupancy(self) -> Counter:
+        """real rows -> dispatch count, from the registry's labeled family."""
+        return Counter({
+            int(dict(c.labels)["rows"]): int(c.value)
+            for c in self.registry.metrics_named("serve_batch_occupancy_total")
+        })
+
+    @property
+    def bucket_counts(self) -> Counter:
+        """bucket label (``b4.s64.m512``) -> dispatch count."""
+        return Counter({
+            dict(c.labels)["bucket"]: int(c.value)
+            for c in self.registry.metrics_named("serve_bucket_dispatch_total")
+        })
+
+    @property
+    def dispatched(self) -> int:
+        return int(self._batches.value)
+
+    @property
+    def rejected(self) -> int:
+        return int(self._rejected_ctr.value)
+
+    def refresh_gauges(self) -> None:
+        """Sample queue occupancy into the gauge (also called at scrape)."""
+        self._queue_gauge.set(self._queue.qsize())
 
     # -- producer side ------------------------------------------------------
 
@@ -96,8 +151,9 @@ class ContinuousBatcher:
             deadline=time.monotonic() + self.max_wait,
         )
         if not bounded_put(self._queue, item, self._stopped):
-            self.rejected += 1
+            self._rejected_ctr.inc()
             raise ShutdownError("batcher closed while request was queued")
+        self.refresh_gauges()
         return fut
 
     # -- worker side --------------------------------------------------------
@@ -127,24 +183,50 @@ class ContinuousBatcher:
         return batch, False
 
     def _dispatch(self, batch: List[_Pending]) -> None:
+        req_ids = [p.request.id for p in batch]
+        t0 = time.monotonic()
         try:
             results = self.engine.run([p.request for p in batch])
         except BaseException as e:
+            if self.events is not None:
+                self.events.emit(
+                    "serve_dispatch", req_ids=req_ids, rows=len(batch),
+                    duration_s=time.monotonic() - t0, ok=False,
+                    error=type(e).__name__,
+                )
             for p in batch:
                 p.future.set_exception(e)
             return
-        self.dispatched += 1
-        self.occupancy[len(batch)] += 1
+        now = time.monotonic()
+        self._batches.inc()
+        self.registry.counter(
+            "serve_batch_occupancy_total", labels={"rows": str(len(batch))},
+            help="dispatches by real-row occupancy",
+        ).inc()
         bucket = getattr(results[0], "bucket", None) if results else None
         if bucket is not None:
-            self.bucket_counts[bucket] += 1
+            self.registry.counter(
+                "serve_bucket_dispatch_total",
+                labels={"bucket": bucket_label(bucket)},
+                help="dispatches by covering lattice bucket",
+            ).inc()
+        if self.events is not None:
+            # the req_ids make this record joinable with the server's
+            # per-request http_request events (satellite: end-to-end ids)
+            self.events.emit(
+                "serve_dispatch", req_ids=req_ids, rows=len(batch),
+                bucket=bucket_label(bucket) if bucket is not None else None,
+                duration_s=now - t0,
+            )
         for p, r in zip(batch, results):
+            self._latency_hist.observe(now - p.request.arrival)
             p.future.set_result(r)
 
     def _worker(self) -> None:
         try:
             while True:
                 batch, terminal = self._collect()
+                self.refresh_gauges()
                 if batch:
                     self._dispatch(batch)
                 if terminal:

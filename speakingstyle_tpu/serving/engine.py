@@ -8,15 +8,24 @@ request buffers donated. Steady-state dispatch then only ever calls the
 stored ``Compiled`` executables — which hard-error on a shape mismatch
 rather than retrace — so the serve loop structurally cannot compile.
 
-Two compile counters back that claim up:
+Two compile counters back that claim up, both living in the engine's
+metrics registry (``speakingstyle_tpu/obs``):
 
-  * ``engine.compile_count`` — incremented by the engine itself around
-    each ``.compile()``;
-  * ``CompileMonitor`` — a ``jax.monitoring`` listener on the backend's
-    own ``/jax/core/compile/backend_compile_duration`` event, which
-    catches compiles the engine *didn't* perform (a stray ``jnp`` call on
-    a novel shape in the dispatch path, say). The serve smoke test and
-    ``bench.py --serve`` assert it reads zero after warmup.
+  * ``serve_compiles_total`` — incremented by the engine itself around
+    each ``.compile()`` (``engine.compile_count`` is a view of it);
+  * ``jax_backend_compiles_total`` — fed by the generalized
+    ``jax.monitoring`` bridge (obs/jaxmon.py) from the backend's own
+    ``/jax/core/compile/backend_compile_duration`` event, which catches
+    compiles the engine *didn't* perform (a stray ``jnp`` call on a
+    novel shape in the dispatch path, say). ``CompileMonitor`` (same
+    module; re-exported here) scopes a counting window — the serve
+    smoke test and ``bench.py --serve`` assert it reads zero after
+    warmup.
+
+Every engine owns its own ``MetricsRegistry`` (pass one to share): the
+dispatch path records per-bucket latency histograms
+(``serve_dispatch_seconds{bucket=...}``) that ``GET /metrics``,
+``/healthz``, and ``bench.py --serve`` all read from the same snapshot.
 """
 
 import contextlib
@@ -28,8 +37,22 @@ from typing import Dict, List, Optional, Tuple, Union
 import numpy as np
 
 from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.obs import CompileMonitor, MetricsRegistry, watch_compiles
 from speakingstyle_tpu.serving.lattice import Bucket, BucketLattice, RequestTooLarge
 from speakingstyle_tpu.training.resilience import retry_io
+
+__all__ = [
+    "CompileMonitor",  # re-export: historical home before obs/jaxmon.py
+    "SynthesisEngine",
+    "SynthesisRequest",
+    "SynthesisResult",
+    "bucket_label",
+]
+
+
+def bucket_label(bucket: Bucket) -> str:
+    """Stable metric-label spelling of a lattice point: ``b4.s64.m512``."""
+    return f"b{bucket.b}.s{bucket.l_src}.m{bucket.t_mel}"
 
 Control = Union[float, np.ndarray]  # scalar, or per-phoneme [src_len] array
 
@@ -64,46 +87,6 @@ class SynthesisResult:
     src_len: int
     bucket: Bucket
     batch_rows: int               # real rows in the dispatch that served this
-
-
-class CompileMonitor:
-    """Counts backend compiles via the jax.monitoring event bus.
-
-    jax has no unregister API, so one module-level listener is installed
-    lazily and individual monitors subscribe to it; ``with monitor:``
-    scopes the counting window.
-    """
-
-    _lock = threading.Lock()
-    _active: List["CompileMonitor"] = []
-    _installed = False
-
-    def __init__(self):
-        self.count = 0
-
-    @classmethod
-    def _listener(cls, name: str, *args, **kwargs):
-        if "/jax/core/compile/backend_compile" in name:
-            with cls._lock:
-                for m in cls._active:
-                    m.count += 1
-
-    def __enter__(self) -> "CompileMonitor":
-        import jax.monitoring
-
-        with CompileMonitor._lock:
-            if not CompileMonitor._installed:
-                jax.monitoring.register_event_duration_secs_listener(
-                    CompileMonitor._listener
-                )
-                CompileMonitor._installed = True
-            CompileMonitor._active.append(self)
-        return self
-
-    def __exit__(self, *exc) -> bool:
-        with CompileMonitor._lock:
-            CompileMonitor._active.remove(self)
-        return False
 
 
 @contextlib.contextmanager
@@ -143,6 +126,7 @@ class SynthesisEngine:
         vocoder: Optional[Tuple] = None,   # (generator, params) or None
         lattice: Optional[BucketLattice] = None,
         model=None,
+        registry: Optional[MetricsRegistry] = None,
     ):
         from speakingstyle_tpu.models.factory import build_model
 
@@ -167,11 +151,33 @@ class SynthesisEngine:
         self._energy_axis = (
             "src" if pp.energy.feature == "phoneme_level" else "mel"
         )
-        self.compile_count = 0
-        self.dispatch_count = 0
+        # per-engine registry (pass one to share); the backend compile
+        # bridge feeds jax_backend_compiles_total into it
+        self.registry = registry if registry is not None else MetricsRegistry()
+        watch_compiles(self.registry)
+        self._compiles = self.registry.counter(
+            "serve_compiles_total",
+            help="XLA programs compiled by the engine (precompile + misses)",
+        )
+        self._dispatches = self.registry.counter(
+            "serve_dispatches_total", help="padded device dispatches executed"
+        )
+        self._request_rows = self.registry.counter(
+            "serve_requests_total", help="requests served through dispatches"
+        )
         self._acoustic: Dict[Bucket, object] = {}
         self._vocoder_exe: Dict[Tuple[int, int], object] = {}
         self._lock = threading.Lock()  # compile-on-miss exclusion
+
+    @property
+    def compile_count(self) -> int:
+        """Engine-performed compiles — a view of the registry counter
+        (no parallel bookkeeping)."""
+        return int(self._compiles.value)
+
+    @property
+    def dispatch_count(self) -> int:
+        return int(self._dispatches.value)
 
     # -- compilation --------------------------------------------------------
 
@@ -235,7 +241,7 @@ class SynthesisEngine:
         jitted = jax.jit(self._acoustic_fn(t), donate_argnums=donate)
         with _quiet_donation():
             self._acoustic[bucket] = jitted.lower(*args).compile()
-        self.compile_count += 1
+        self._compiles.inc()
 
     def _compile_vocoder(self, b: int, t: int):
         import jax
@@ -254,7 +260,7 @@ class SynthesisEngine:
             self._vocoder_exe[(b, t)] = jitted.lower(
                 params, jax.ShapeDtypeStruct((b, t, self.n_mels), jnp.float32)
             ).compile()
-        self.compile_count += 1
+        self._compiles.inc()
 
     # -- admission geometry -------------------------------------------------
 
@@ -320,6 +326,8 @@ class SynthesisEngine:
             if self.vocoder is not None and \
                     (bucket.b, bucket.t_mel) not in self._vocoder_exe:
                 self._compile_vocoder(bucket.b, bucket.t_mel)
+        t_dispatch = time.monotonic()  # after any compile-on-miss: latency
+        # histograms measure steady-state dispatch, not XLA
         b, l, t = bucket.b, bucket.l_src, bucket.t_mel
         n = len(requests)
 
@@ -381,7 +389,13 @@ class SynthesisEngine:
         durations = np.asarray(out["durations"])
         pitch = np.asarray(out["pitch_prediction"])
         energy = np.asarray(out["energy_prediction"])
-        self.dispatch_count += 1
+        self._dispatches.inc()
+        self._request_rows.inc(n)
+        self.registry.histogram(
+            "serve_dispatch_seconds",
+            labels={"bucket": bucket_label(bucket)},
+            help="wall time of one padded device dispatch, per lattice bucket",
+        ).observe(time.monotonic() - t_dispatch)
 
         results = []
         for i, r in enumerate(requests):

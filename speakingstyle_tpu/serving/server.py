@@ -4,17 +4,30 @@
 thread does the host-side work (JSON parse, G2P, reference-mel lookup),
 submits a SynthesisRequest, and blocks on its future — so concurrent
 HTTP clients coalesce into shared device dispatches without any async
-framework. The handler never touches jax (JL008 enforces that compiles
-stay out of request handlers); all device work happens on the batcher's
-single dispatch thread against AOT-precompiled executables.
+framework. The synthesize handler never compiles or dispatches jax work
+(JL008 enforces that compiles stay out of request handlers); all device
+work happens on the batcher's single dispatch thread against
+AOT-precompiled executables. The one jax touch in a handler is the
+/debug/profile capture hook, which only starts/stops the profiler.
 
 API:
-  POST /synthesize   {"text": ..., "speaker_id"?, "pitch_control"?,
-                      "energy_control"?, "duration_control"?,
-                      "ref_audio"? (server-side wav path)}
-                     -> audio/wav (16-bit PCM)
-  GET  /healthz      -> JSON engine/batcher stats (compile counter,
-                        batch-occupancy histogram, lattice size)
+  POST /synthesize     {"text": ..., "speaker_id"?, "pitch_control"?,
+                        "energy_control"?, "duration_control"?,
+                        "ref_audio"? (server-side wav path)}
+                       -> audio/wav (16-bit PCM); X-Request-Id on every
+                       response (success AND error JSON), joinable with
+                       the batcher's serve_dispatch span/event records
+  GET  /healthz        -> JSON view of the metrics-registry snapshot
+                        (compile counter, batch occupancy, queue depth)
+  GET  /metrics        -> Prometheus text exposition of the same registry
+  POST /debug/profile?seconds=N
+                       -> capture a jax.profiler trace from the live
+                       process (serve.debug_profile gates it)
+
+The registry (obs/) is the single accounting path: ``stats()`` is a view
+of ``registry.snapshot()`` — the request counter, occupancy histogram,
+and compile counters have no server-side shadow copies (and therefore no
+lock-discipline gap between the write and read sides).
 """
 
 import concurrent.futures
@@ -25,10 +38,12 @@ import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
 from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.obs import JsonlEventLog
 from speakingstyle_tpu.serving.batcher import ContinuousBatcher, ShutdownError
 from speakingstyle_tpu.serving.engine import SynthesisEngine, SynthesisRequest
 from speakingstyle_tpu.serving.lattice import RequestTooLarge
@@ -149,15 +164,30 @@ class SynthesisServer:
         host: Optional[str] = None,
         port: Optional[int] = None,
         request_timeout: float = 60.0,
+        events: Optional[JsonlEventLog] = None,
+        profile_dir: Optional[str] = None,
     ):
         serve = engine.cfg.serve
         self.engine = engine
         self.frontend = frontend
-        self.batcher = ContinuousBatcher(engine)
+        self.registry = engine.registry
+        self.events = events
+        self.batcher = ContinuousBatcher(engine, events=events)
         self.request_timeout = request_timeout
         self.started = time.monotonic()
-        self._req_counter = 0
-        self._counter_lock = threading.Lock()
+        self.profile_dir = profile_dir or os.path.join(
+            engine.cfg.train.path.log_path, "serve_profile"
+        )
+        self._profile_lock = threading.Lock()  # one capture at a time
+        # the request-id sequence IS the request counter: Counter.inc()
+        # returns the post-increment value under the metric's own lock,
+        # so there is no separate _req_counter to keep in sync
+        self._requests = self.registry.counter(
+            "serve_http_requests_total", help="synthesize requests admitted"
+        )
+        self._http_errors = self.registry.counter(
+            "serve_http_errors_total", help="synthesize requests failed"
+        )
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -165,43 +195,76 @@ class SynthesisServer:
             def log_message(self, fmt, *args):
                 pass
 
-            def _json(self, code: int, obj: Dict):
+            def _json(self, code: int, obj: Dict, req_id: Optional[str] = None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                if req_id is not None:
+                    self.send_header("X-Request-Id", req_id)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _text(self, code: int, text: str, content_type: str):
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path != "/healthz":
-                    return self._json(404, {"error": f"no route {self.path}"})
-                self._json(200, outer.stats())
+                if self.path == "/healthz":
+                    return self._json(200, outer.stats())
+                if self.path == "/metrics":
+                    outer.batcher.refresh_gauges()
+                    return self._text(
+                        200,
+                        outer.registry.prometheus_text(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                return self._json(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
-                if self.path != "/synthesize":
+                parsed = urlparse(self.path)
+                if parsed.path == "/debug/profile":
+                    return self._profile(parsed)
+                if parsed.path != "/synthesize":
                     return self._json(404, {"error": f"no route {self.path}"})
+                # the req_id is minted HERE and rides through frontend ->
+                # batcher -> engine as SynthesisRequest.id, so one
+                # request's http_request/serve_dispatch records (and the
+                # X-Request-Id the client sees, errors included) all join
+                req_id = outer.next_req_id()
+                t0 = time.monotonic()
+                status, err = 200, None
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n) or b"{}")
-                    result = outer.synthesize(payload)
+                    result = outer.synthesize(payload, req_id=req_id)
                 except (ValueError, RequestTooLarge) as e:
-                    return self._json(400, {"error": str(e)})
+                    status, err = 400, str(e)
                 except ShutdownError as e:
-                    return self._json(503, {"error": str(e)})
+                    status, err = 503, str(e)
                 # concurrent.futures.TimeoutError only aliases the builtin
                 # from 3.11; catch both on 3.10
                 except (TimeoutError, concurrent.futures.TimeoutError):
-                    return self._json(504, {"error": "synthesis timed out"})
+                    status, err = 504, "synthesis timed out"
+                if err is not None:
+                    outer._request_done(req_id, parsed.path, status, t0)
+                    return self._json(status, {"error": err, "id": req_id},
+                                      req_id=req_id)
                 if result.wav is None:
                     # vocoder-less engine: return the mel as JSON
+                    outer._request_done(req_id, parsed.path, 200, t0)
                     return self._json(200, {
                         "id": result.id,
                         "mel_len": result.mel_len,
                         "mel": result.mel.tolist(),
-                    })
+                    }, req_id=req_id)
                 sr = outer.engine.cfg.preprocess.preprocessing.audio.sampling_rate
                 body = wav_bytes(result.wav, sr)
+                outer._request_done(req_id, parsed.path, 200, t0)
                 self.send_response(200)
                 self.send_header("Content-Type", "audio/wav")
                 self.send_header("Content-Length", str(len(body)))
@@ -209,6 +272,25 @@ class SynthesisServer:
                 self.send_header("X-Batch-Rows", str(result.batch_rows))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _profile(self, parsed):
+                if not outer.engine.cfg.serve.debug_profile:
+                    return self._json(
+                        403, {"error": "serve.debug_profile is disabled"}
+                    )
+                raw = parse_qs(parsed.query).get("seconds", ["3"])[0]
+                try:
+                    seconds = float(raw)
+                except ValueError:
+                    return self._json(
+                        400, {"error": f"seconds={raw!r} is not a number"}
+                    )
+                if not 0 < seconds <= 60:
+                    return self._json(
+                        400, {"error": "seconds must be in (0, 60]"}
+                    )
+                ok, out = outer.capture_profile(seconds)
+                return self._json(200 if ok else 409, out)
 
         self.httpd = ThreadingHTTPServer(
             (host if host is not None else serve.host,
@@ -219,25 +301,86 @@ class SynthesisServer:
 
     # -- request path (also used directly by tests) -------------------------
 
-    def synthesize(self, payload: Dict):
-        with self._counter_lock:
-            self._req_counter += 1
-            req_id = f"req{self._req_counter:08d}"
+    def next_req_id(self) -> str:
+        return f"req{int(self._requests.inc()):08d}"
+
+    def synthesize(self, payload: Dict, req_id: Optional[str] = None):
+        if req_id is None:
+            req_id = self.next_req_id()
         request = self.frontend.request(req_id, payload)
         future = self.batcher.submit(request)
         return future.result(timeout=self.request_timeout)
 
+    def _request_done(
+        self, req_id: str, path: str, status: int, t0: float
+    ) -> None:
+        dur = time.monotonic() - t0
+        if status >= 400:
+            self._http_errors.inc()
+        self.registry.histogram(
+            "serve_http_request_seconds",
+            labels={"status": str(status)},
+            help="HTTP handler wall time (parse + G2P + batcher wait)",
+        ).observe(dur)
+        if self.events is not None:
+            self.events.emit(
+                "http_request", req_id=req_id, path=path, status=status,
+                duration_s=dur,
+            )
+
     def stats(self) -> Dict:
+        """The /healthz payload: a VIEW of ``registry.snapshot()``.
+
+        The pre-obs version read ``_req_counter`` and batcher fields
+        directly, without the locks the write side held; every number
+        here now comes out of the registry (whose metrics carry their
+        own locks), so there is no second bookkeeping path to drift.
+        """
+        self.batcher.refresh_gauges()
+        snap = self.registry.snapshot()
+        counters, gauges = snap["counters"], snap["gauges"]
         return {
             "uptime_s": round(time.monotonic() - self.started, 1),
             "lattice_points": len(self.engine.lattice),
-            "compile_count": self.engine.compile_count,
-            "dispatches": self.engine.dispatch_count,
-            "batch_occupancy": dict(
-                sorted(self.batcher.occupancy.items())
+            "compile_count": int(counters.get("serve_compiles_total", 0)),
+            "backend_compiles": int(
+                counters.get("jax_backend_compiles_total", 0)
             ),
-            "requests": self._req_counter,
+            "dispatches": int(counters.get("serve_dispatches_total", 0)),
+            "queue_depth": int(gauges.get("serve_queue_depth", 0)),
+            "batch_occupancy": {
+                str(rows): count
+                for rows, count in sorted(self.batcher.occupancy.items())
+            },
+            "requests": int(counters.get("serve_http_requests_total", 0)),
+            "errors": int(counters.get("serve_http_errors_total", 0)),
         }
+
+    def capture_profile(self, seconds: float):
+        """On-demand ``jax.profiler`` window over the live serve process
+        (``POST /debug/profile?seconds=N``). One capture at a time; the
+        trace lands in a numbered subdirectory of ``profile_dir``."""
+        import jax
+
+        if not self._profile_lock.acquire(blocking=False):
+            return False, {"error": "a profile capture is already running"}
+        try:
+            seq = int(self.registry.counter(
+                "serve_profile_captures_total",
+                help="on-demand jax.profiler captures",
+            ).inc())
+            trace_dir = os.path.join(self.profile_dir, f"capture_{seq:04d}")
+            os.makedirs(trace_dir, exist_ok=True)
+            jax.profiler.start_trace(trace_dir)
+            time.sleep(seconds)
+            jax.profiler.stop_trace()
+        finally:
+            self._profile_lock.release()
+        if self.events is not None:
+            self.events.emit(
+                "profile_capture", trace_dir=trace_dir, seconds=seconds
+            )
+        return True, {"trace_dir": trace_dir, "seconds": seconds}
 
     @property
     def address(self):

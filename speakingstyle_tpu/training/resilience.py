@@ -55,6 +55,16 @@ def retry_io(
             attempt += 1
             if attempt > retries:
                 raise
+            # telemetry: retries are the leading indicator of a sick
+            # filesystem/interconnect; counted in the default registry
+            # (obs imported lazily — this module must stay importable
+            # before jax/obs in minimal contexts)
+            from speakingstyle_tpu.obs import get_registry
+
+            get_registry().counter(
+                "io_retries_total",
+                help="transient I/O errors retried (loads + transfers)",
+            ).inc()
             print(
                 f"[resilience] transient {type(e).__name__} "
                 f"{f'({describe}) ' if describe else ''}retry "
@@ -77,6 +87,12 @@ class Quarantine:
         with self._lock:
             self.bad[sample_id] = f"{type(err).__name__}: {err}"
             n = len(self.bad)
+        from speakingstyle_tpu.obs import get_registry
+
+        get_registry().counter(
+            "quarantined_samples_total",
+            help="distinct samples quarantined after exhausting retries",
+        ).inc()
         print(
             f"[resilience] quarantined sample {sample_id!r} "
             f"({n}/{self.budget} budget): {type(err).__name__}: {err}"

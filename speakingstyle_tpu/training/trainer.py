@@ -17,6 +17,7 @@ import jax
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from speakingstyle_tpu import obs
 from speakingstyle_tpu.analysis import contracts
 from speakingstyle_tpu.configs.config import Config
 from speakingstyle_tpu.models.loss import fastspeech2_loss
@@ -220,6 +221,7 @@ def run_training(
     vocoder=None,
     profile_dir: Optional[str] = None,
     profile_steps: tuple = (10, 20),
+    registry: Optional[obs.MetricsRegistry] = None,
 ):
     """The full training loop (reference: train.py:21-173).
 
@@ -238,6 +240,17 @@ def run_training(
     loader errors are retried then quarantined per sample. Faults from
     ``SPEAKINGSTYLE_FAULTS`` (training/faults.py) are injected to drill
     each of those paths.
+
+    Telemetry (``speakingstyle_tpu/obs``, ARCHITECTURE.md
+    "Observability"): the loop records per-step wall time split into
+    data-wait (time blocked on the prefetcher) vs step time into
+    ``registry`` histograms, wraps the jitted step in
+    ``jax.profiler.StepTraceAnnotation`` so on-demand traces label step
+    boundaries, and — via TrainLogger — appends structured JSONL events
+    (``train_step``/``val``/``checkpoint_save``/``rollback``/
+    ``fault_fire``/``preempt_flush``/``quarantine``; schema in
+    obs/events.py) to a rotating ``events.jsonl`` under
+    ``train.path.log_path`` (``train.obs.*`` knobs).
     """
     import time
     import jax.numpy as jnp
@@ -255,6 +268,27 @@ def run_training(
     res = cfg.train.resilience
     total_step = max_steps if max_steps is not None else steps.total_step
     plan = faults.FaultPlan.from_env()
+
+    registry = registry if registry is not None else obs.get_registry()
+    step_hist = registry.histogram(
+        "train_step_seconds",
+        help="per-step wall time excluding data wait (host dispatch; "
+             "device-honest at log boundaries where the loop syncs)",
+    )
+    wait_hist = registry.histogram(
+        "train_data_wait_seconds",
+        help="per-step time blocked on the prefetcher",
+    )
+    steps_ctr = registry.counter("train_steps_total", help="optimizer steps run")
+    rollback_ctr = registry.counter(
+        "train_rollbacks_total", help="NaN-sentinel rollbacks taken"
+    )
+    save_ctr = registry.counter(
+        "checkpoint_saves_total", help="checkpoints enqueued/flushed"
+    )
+    fault_ctr = registry.counter(
+        "faults_fired_total", help="injected faults fired (drills)"
+    )
 
     if cfg.train.fast_prng:
         try:
@@ -329,7 +363,7 @@ def run_training(
         )
         return DevicePrefetcher(
             iter(batcher), mesh=mesh, transfer_retries=res.loader_retries,
-            transfer_backoff=res.loader_backoff,
+            transfer_backoff=res.loader_backoff, registry=registry,
         )
 
     def fresh_state() -> TrainState:
@@ -357,7 +391,19 @@ def run_training(
         seed=0,
     )
 
-    logger = TrainLogger(cfg.train.path.log_path) if log else None
+    logger = None
+    if log:
+        events = (
+            obs.JsonlEventLog(
+                cfg.train.path.log_path,
+                max_bytes=cfg.train.obs.events_max_bytes,
+                keep=cfg.train.obs.events_keep,
+            )
+            if cfg.train.obs.events else None
+        )
+        logger = TrainLogger(
+            cfg.train.path.log_path, registry=registry, events=events
+        )
     if synth_callback == "default":
         synth_callback = default_synth_callback(cfg, logger, vocoder=vocoder)
     step_rng = jax.random.PRNGKey(cfg.train.seed + 1)
@@ -369,17 +415,28 @@ def run_training(
     last_val: Optional[float] = None
     last_saved: Optional[int] = None
     window_t0, window_step0, window_frames = time.perf_counter(), step, 0
+    window_wait = window_compute = 0.0
     trace_active = False
     shutdown = resilience.GracefulShutdown()
     try:
         with shutdown:
             while step < total_step and not shutdown.requested:
+                t_iter = time.perf_counter()
                 try:
                     batch, arrays = next(prefetch)
                 except StopIteration:
                     break
+                # the data-wait vs device-time split: time blocked on the
+                # prefetcher here, the rest of the iteration below
+                data_wait = time.perf_counter() - t_iter
+                wait_hist.observe(data_wait)
+                window_wait += data_wait
                 if plan.fire("nan_grads", step + 1):
                     arrays = faults.poison_batch(arrays)
+                    fault_ctr.inc()
+                    if logger:
+                        logger.event("fault_fire", kind="nan_grads",
+                                     step=step + 1)
                 if (
                     profile_dir is not None
                     and not trace_active
@@ -389,24 +446,37 @@ def run_training(
                     trace_active = True
                 # step_fn folds state.step into the key, so passing the same
                 # step_rng every iteration yields a fresh per-step stream
-                state, losses = train_step(state, arrays, step_rng)  # jaxlint: disable=JL006
+                with jax.profiler.StepTraceAnnotation("train", step_num=step):
+                    state, losses = train_step(state, arrays, step_rng)  # jaxlint: disable=JL006
                 step += 1
+                steps_ctr.inc()
+                step_time = time.perf_counter() - t_iter - data_wait
+                step_hist.observe(step_time)
+                window_compute += step_time
                 window_frames += int(batch.mel_lens.sum())  # host-side, no sync
                 if trace_active and step - start_step >= profile_steps[1]:
                     jax.block_until_ready(losses["total_loss"])
                     jax.profiler.stop_trace()
                     trace_active = False
                 if plan.fire("sigterm", step):
+                    fault_ctr.inc()
+                    if logger:
+                        logger.event("fault_fire", kind="sigterm", step=step)
                     faults.deliver_sigterm()
 
                 if step % steps.log_step == 0:
                     # host boundary: the loop blocks here for logging anyway,
-                    # so the sentinel read adds no extra sync point
+                    # so the sentinel read adds no extra sync point. The
+                    # drain time is charged to the window's compute bucket —
+                    # it IS device time the async dispatches above deferred.
+                    t_sync = time.perf_counter()
                     jax.block_until_ready(losses["total_loss"])
+                    window_compute += time.perf_counter() - t_sync
                     if "_finite" in losses and not bool(losses["_finite"]):
                         n = guard.trip(step)  # raises past max_rollbacks
                         ckpt.wait()
                         good = ckpt.latest_step()
+                        rollback_ctr.inc()
                         msg = (
                             f"[resilience] non-finite losses/grads at step "
                             f"{step}; rollback {n}/{res.max_rollbacks} to "
@@ -416,6 +486,10 @@ def run_training(
                         print(msg)
                         if logger:
                             logger.note(msg)
+                            logger.event(
+                                "rollback", step=step, rollback_n=n,
+                                restore_step=good,
+                            )
                         prefetch.stop()
                         if good is not None:
                             state = ckpt.restore(abstract_template, step=good)
@@ -426,6 +500,7 @@ def run_training(
                         window_t0, window_step0, window_frames = (
                             time.perf_counter(), step, 0,
                         )
+                        window_wait = window_compute = 0.0
                         continue
                     guard.ok()
                     if logger:
@@ -433,24 +508,38 @@ def run_training(
                             public_losses(losses), "train_step.losses"
                         )
                         lr = float(schedule(jnp.asarray(step - 1)))
+                        n_window = step - window_step0
+                        dt = time.perf_counter() - window_t0
+                        timing = None
+                        if n_window > 0:
+                            timing = {
+                                "step_time_s": window_compute / n_window,
+                                "data_wait_s": window_wait / n_window,
+                            }
+                            if dt > 0:
+                                timing["steps_per_sec"] = n_window / dt
+                                timing["mel_frames_per_sec"] = window_frames / dt
                         logger.log(
                             step,
                             {k: float(v) for k, v in public_losses(losses).items()},
                             lr=lr,
+                            timing=timing,
                         )
-                        dt = time.perf_counter() - window_t0
-                        if dt > 0 and step > window_step0:
+                        if timing and "steps_per_sec" in timing:
                             logger.log_throughput(
-                                step, (step - window_step0) / dt, window_frames / dt
+                                step, timing["steps_per_sec"],
+                                timing["mel_frames_per_sec"],
                             )
                         window_t0, window_step0, window_frames = (
                             time.perf_counter(), step, 0,
                         )
+                        window_wait = window_compute = 0.0
                 if synth_callback is not None and step % steps.synth_step == 0:
                     synth_callback(state, batch, arrays, step, model)
                 if step % steps.val_step == 0:
                     with DevicePrefetcher(
-                        val_batcher.epoch(shuffle=False), mesh=mesh
+                        val_batcher.epoch(shuffle=False), mesh=mesh,
+                        registry=registry,
                     ) as val_prefetch:
                         val_losses = evaluate(eval_step, state, val_prefetch)
                     # evaluate() already returns host floats
@@ -459,12 +548,18 @@ def run_training(
                         logger.log(step, val_losses, prefix="val")
                 if step % steps.save_step == 0:
                     ckpt.save(step, state, val_loss=last_val)
+                    save_ctr.inc()
+                    if logger:
+                        logger.event("checkpoint_save", step=step)
                     last_saved = step
 
             # always flush a final checkpoint: covers total_step not
             # divisible by save_step AND the SIGTERM/SIGINT preemption path
             if step > start_step and last_saved != step:
                 ckpt.save(step, state, val_loss=last_val, block=True)
+                save_ctr.inc()
+                if logger:
+                    logger.event("checkpoint_save", step=step, final=True)
                 last_saved = step
             if shutdown.requested:
                 msg = (
@@ -474,6 +569,9 @@ def run_training(
                 print(msg)
                 if logger:
                     logger.note(msg)
+                    logger.event(
+                        "preempt_flush", signal=shutdown.signame, step=step
+                    )
     finally:
         if trace_active:
             jax.profiler.stop_trace()  # run ended inside the profile window
@@ -483,6 +581,7 @@ def run_training(
                 f"[resilience] {len(quarantine.bad)} quarantined sample(s): "
                 f"{sorted(quarantine.bad)}"
             )
+            logger.event("quarantine", samples=sorted(quarantine.bad))
         if logger:
             logger.close()
         ckpt.close()
@@ -492,11 +591,22 @@ def run_training(
 class TrainLogger:
     """TensorBoard scalars/figures/audio + append-only log.txt (reference:
     train.py:53-61, utils/tools.py:82-107). tensorboardX is optional; the
-    text log always works."""
+    text log always works.
 
-    def __init__(self, log_dir: str, use_tensorboard: bool = True):
+    With ``registry``/``events`` attached (obs/), every ``log()`` call
+    also updates the metric gauges and appends one structured JSONL
+    record (``train_step``/``val`` — schema in obs/events.py), so the
+    human-readable log and the machine-readable telemetry cannot drift:
+    they are written by the same call from the same values.
+    """
+
+    def __init__(self, log_dir: str, use_tensorboard: bool = True,
+                 registry: Optional[obs.MetricsRegistry] = None,
+                 events: Optional[obs.JsonlEventLog] = None):
         os.makedirs(log_dir, exist_ok=True)
         self.txt = open(os.path.join(log_dir, "log.txt"), "a")
+        self.registry = registry
+        self.events = events
         self.tb = None
         if use_tensorboard:
             try:
@@ -506,7 +616,9 @@ class TrainLogger:
             except ImportError:
                 pass
 
-    def log(self, step: int, losses: Dict[str, float], lr: Optional[float] = None, prefix: str = "train"):
+    def log(self, step: int, losses: Dict[str, float],
+            lr: Optional[float] = None, prefix: str = "train",
+            timing: Optional[Dict[str, float]] = None):
         msg = f"[{prefix}] Step {step}, " + ", ".join(
             f"{k}: {float(v):.4f}" for k, v in losses.items()
         )
@@ -519,12 +631,34 @@ class TrainLogger:
                 self.tb.add_scalar(f"{prefix}/{k}", float(v), step)
             if lr is not None:
                 self.tb.add_scalar(f"{prefix}/lr", lr, step)
+        if self.registry is not None:
+            self.registry.gauge("train_step", help="last logged step").set(step)
+            for k, v in losses.items():
+                # values arrive as host floats (the caller converts at the
+                # log boundary); Gauge.set coerces, no device sync here
+                self.registry.gauge(
+                    "train_loss", labels={"loss": k, "split": prefix}
+                ).set(v)
+        self.event(
+            "train_step" if prefix == "train" else prefix,
+            step=step,
+            **{k: float(v) for k, v in losses.items()},
+            **({"lr": lr} if lr is not None else {}),
+            **(timing or {}),
+        )
+
+    def event(self, name: str, **fields):
+        """Append one structured record to events.jsonl (no-op without an
+        event log attached)."""
+        if self.events is not None:
+            self.events.emit(name, **fields)
 
     def note(self, msg: str):
         """Raw line into log.txt (resilience events: rollbacks, SIGTERM
         flushes, quarantine summaries) — greppable next to the step log."""
         self.txt.write(msg + "\n")
         self.txt.flush()
+        self.event("note", msg=msg)
 
     def log_throughput(self, step: int, steps_per_sec: float, frames_per_sec: float):
         self.txt.write(
@@ -553,6 +687,8 @@ class TrainLogger:
 
     def close(self):
         self.txt.close()
+        if self.events is not None:
+            self.events.close()
         if self.tb is not None:
             self.tb.close()
 

@@ -468,6 +468,69 @@ def test_jl008_negative_re_compile_untouched():
 
 
 # ---------------------------------------------------------------------------
+# JL009 — wall clock used for durations
+# ---------------------------------------------------------------------------
+
+
+def test_jl009_positive_time_time_subtraction():
+    assert "JL009" in _codes("""
+        import time
+
+        def measure(fn):
+            t0 = time.time()
+            fn()
+            return time.time() - t0
+    """)
+
+
+def test_jl009_positive_from_import_and_alias():
+    assert "JL009" in _codes("""
+        from time import time
+
+        def measure(fn):
+            start = time()
+            fn()
+            return time() - start
+    """)
+
+
+def test_jl009_positive_stamp_name_subtracted_later():
+    assert "JL009" in _codes("""
+        import time
+
+        def loop(items):
+            began = time.time()
+            for it in items:
+                handle(it)
+            report(elapsed=time.monotonic() - began)
+    """)
+
+
+def test_jl009_negative_monotonic_and_perf_counter():
+    assert "JL009" not in _codes("""
+        import time
+
+        def measure(fn):
+            t0 = time.monotonic()
+            fn()
+            d1 = time.monotonic() - t0
+            t1 = time.perf_counter()
+            fn()
+            return d1 + (time.perf_counter() - t1)
+    """)
+
+
+def test_jl009_negative_timestamp_only_use():
+    # wall time as a *timestamp* (never subtracted) is the sanctioned use
+    assert "JL009" not in _codes("""
+        import time
+
+        def record(log, event):
+            log.emit({"ts": time.time(), "event": event})
+    """)
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -578,6 +641,10 @@ def test_every_rule_is_non_vacuous():
     baselined) — rules that never fire are dead weight."""
     fired = {f.rule for f in linter.lint_paths()}
     fired |= {fp.split(":", 1)[0] for fp in linter.load_baseline()}
+    # JL009 is deliberately absent: the tree already follows the
+    # monotonic-clock duration discipline (zero wall-clock subtractions,
+    # so nothing to baseline) — the desired steady state for a
+    # preventive rule; its fixtures above keep it non-vacuous.
     for code in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
                  "JL007", "JL008"):
         assert code in fired, f"{code} never fires on the real tree"

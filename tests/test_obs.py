@@ -1,0 +1,334 @@
+"""Telemetry suite (tier-1): registry, histogram math, JSONL events, spans,
+the events CLI, and the instrumented training smoke.
+
+Layers:
+  1. registry — identity/creation semantics, thread-safety under
+     concurrent writers (exact totals), histogram percentiles against a
+     numpy reference (error bounded by one bucket width), snapshot and
+     Prometheus-text export;
+  2. events — schema round-trip (every record carries ts + event),
+     numpy-value coercion, size rotation, cross-rotation reads, and the
+     summarize/filter CLI;
+  3. spans — duration into the histogram + a joinable JSONL record;
+  4. the training smoke — a supertiny run_training populates
+     step-time/data-wait histograms and writes train_step events with
+     the documented step/loss/step_time_s/data_wait_s fields (the
+     acceptance criterion for the JSONL export layer).
+"""
+
+import io
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from speakingstyle_tpu.obs import (
+    Counter,
+    Gauge,
+    Histogram,
+    JsonlEventLog,
+    MetricsRegistry,
+    Span,
+    get_registry,
+    read_events,
+)
+from speakingstyle_tpu.obs import cli as obs_cli
+
+# ---------------------------------------------------------------------------
+# 1. registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_creation_is_idempotent_and_typed():
+    reg = MetricsRegistry()
+    c1 = reg.counter("a_total", help="h")
+    c2 = reg.counter("a_total")
+    assert c1 is c2
+    # same name, different labels -> different child of the family
+    c3 = reg.counter("a_total", labels={"k": "v"})
+    assert c3 is not c1
+    assert {m is c1 or m is c3 for m in reg.metrics_named("a_total")} == {True}
+    with pytest.raises(TypeError):
+        reg.gauge("a_total")
+
+
+def test_counter_inc_returns_sequence_and_rejects_negative():
+    c = MetricsRegistry().counter("seq_total")
+    assert [int(c.inc()) for _ in range(3)] == [1, 2, 3]
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+
+def test_gauge_set_inc_dec():
+    g = MetricsRegistry().gauge("depth")
+    g.set(5)
+    g.inc(2)
+    g.dec()
+    assert g.value == 6
+
+
+def test_registry_thread_safety_exact_totals():
+    """Concurrent writers on one counter, one gauge, one histogram: no
+    update may be lost (the whole point of the shared registry is that
+    HTTP handler threads, the dispatch thread, and scrapers race it)."""
+    reg = MetricsRegistry()
+    c = reg.counter("hits_total")
+    h = reg.histogram("lat_seconds", edges=(0.1, 1.0, 10.0))
+    n_threads, n_iter = 8, 5000
+
+    def writer(tid):
+        for i in range(n_iter):
+            c.inc()
+            h.observe(0.05 * (1 + (i + tid) % 3))
+            # creation races too: same (name, labels) from many threads
+            reg.counter("hits_by_thread_total", labels={"t": str(tid)}).inc()
+
+    threads = [threading.Thread(target=writer, args=(t,)) for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert int(c.value) == n_threads * n_iter
+    assert h.count == n_threads * n_iter
+    per_thread = [int(m.value) for m in reg.metrics_named("hits_by_thread_total")]
+    assert per_thread == [n_iter] * n_threads
+
+
+def test_histogram_percentiles_vs_numpy_reference():
+    """The interpolated estimate must land within one bucket width of the
+    exact numpy percentile, across distributions and quantiles."""
+    rng = np.random.default_rng(0)
+    edges = tuple(float(e) for e in np.geomspace(1e-4, 60.0, 24))
+    for dist in (
+        rng.lognormal(-4.0, 1.0, 4000),          # latency-shaped
+        rng.uniform(0.001, 0.5, 4000),           # flat
+        np.full(100, 0.0123),                     # degenerate: one value
+    ):
+        h = Histogram("x_seconds", edges=edges)
+        for v in dist:
+            h.observe(float(v))
+        for q in (0.50, 0.95, 0.99):
+            want = float(np.percentile(dist, q * 100))
+            got = h.percentile(q)
+            i = int(np.searchsorted(edges, want))
+            lo = edges[i - 1] if i > 0 else float(dist.min())
+            hi = edges[i] if i < len(edges) else float(dist.max())
+            width = hi - lo
+            assert abs(got - want) <= width + 1e-12, (q, got, want, width)
+
+
+def test_histogram_empty_and_overflow():
+    h = Histogram("x", edges=(1.0, 2.0))
+    assert h.percentile(0.5) is None
+    h.observe(5.0)  # overflow bin: bounded by the observed max
+    assert h.percentile(0.99) == 5.0
+    snap = h.snapshot()
+    assert snap["count"] == 1 and snap["buckets"][2.0] == 0
+
+
+def test_snapshot_and_prometheus_text():
+    reg = MetricsRegistry()
+    reg.counter("req_total", help="requests").inc(3)
+    reg.gauge("depth").set(7)
+    reg.histogram(
+        "lat_seconds", edges=(0.1, 1.0), labels={"bucket": "b1.s16.m32"}
+    ).observe(0.5)
+    snap = reg.snapshot()
+    assert snap["counters"]["req_total"] == 3
+    assert snap["gauges"]["depth"] == 7
+    hist = snap["histograms"]['lat_seconds{bucket="b1.s16.m32"}']
+    assert hist["count"] == 1 and hist["buckets"][1.0] == 1
+
+    text = reg.prometheus_text()
+    assert "# HELP req_total requests" in text
+    assert "# TYPE req_total counter" in text
+    assert "req_total 3" in text
+    assert "depth 7" in text
+    assert 'lat_seconds_bucket{bucket="b1.s16.m32",le="0.1"} 0' in text
+    assert 'lat_seconds_bucket{bucket="b1.s16.m32",le="+Inf"} 1' in text
+    assert 'lat_seconds_count{bucket="b1.s16.m32"} 1' in text
+
+
+def test_default_registry_is_a_singleton():
+    assert get_registry() is get_registry()
+
+
+def test_retry_io_counts_retries_in_default_registry():
+    """The data layer's retry-with-backoff reports into io_retries_total
+    (the leading indicator of a sick filesystem on preemptible slices)."""
+    from speakingstyle_tpu.training.resilience import retry_io
+
+    before = get_registry().value("io_retries_total")
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise IOError("transient")
+        return "ok"
+
+    assert retry_io(flaky, retries=3, backoff=0.0, sleep=lambda _: None) == "ok"
+    assert get_registry().value("io_retries_total") - before == 2
+
+
+# ---------------------------------------------------------------------------
+# 2. JSONL events
+# ---------------------------------------------------------------------------
+
+
+def test_event_schema_roundtrip(tmp_path):
+    log = JsonlEventLog(str(tmp_path))
+    log.emit("train_step", step=3, total_loss=1.25, step_time_s=0.01,
+             data_wait_s=0.002)
+    log.emit("rollback", step=4, rollback_n=1, restore_step=None)
+    # numpy values must coerce, not crash the writer
+    log.emit("val", step=np.int64(5), total_loss=np.float32(0.5),
+             arr=np.asarray([1, 2]))
+    log.close()
+    records = list(read_events(str(tmp_path)))
+    assert [r["event"] for r in records] == ["train_step", "rollback", "val"]
+    for r in records:
+        assert isinstance(r["ts"], float) and "event" in r
+    assert records[0]["step"] == 3 and records[0]["data_wait_s"] == 0.002
+    assert records[2]["step"] == 5 and records[2]["arr"] == [1, 2]
+    # filtered read
+    assert [r["event"] for r in read_events(str(tmp_path), event="rollback")] \
+        == ["rollback"]
+
+
+def test_event_rotation_keeps_order_and_bounds_files(tmp_path):
+    log = JsonlEventLog(str(tmp_path), max_bytes=600, keep=2)
+    for i in range(40):
+        log.emit("tick", i=i)
+    log.close()
+    live = os.path.join(str(tmp_path), "events.jsonl")
+    assert os.path.exists(live) and os.path.exists(live + ".1")
+    assert not os.path.exists(live + ".3")  # keep=2 bounds the set
+    assert os.path.getsize(live) <= 600
+    records = list(read_events(str(tmp_path)))
+    idx = [r["i"] for r in records]
+    assert idx == sorted(idx)          # oldest-first across rotation
+    assert idx[-1] == 39               # the newest record survives
+    # a torn tail (killed writer) is skipped, not fatal
+    with open(live, "a") as fh:
+        fh.write('{"ts": 1.0, "event": "torn')
+    assert [r["i"] for r in read_events(str(tmp_path))] == idx
+
+
+def test_malformed_and_blank_lines_are_skipped(tmp_path):
+    p = tmp_path / "events.jsonl"
+    p.write_text('\n{"ts": 1.0, "event": "ok"}\nnot json\n')
+    assert [r["event"] for r in read_events(str(p))] == ["ok"]
+
+
+# ---------------------------------------------------------------------------
+# 3. spans
+# ---------------------------------------------------------------------------
+
+
+def test_span_records_histogram_and_joinable_event(tmp_path):
+    reg = MetricsRegistry()
+    log = JsonlEventLog(str(tmp_path))
+    with Span("serve_dispatch", registry=reg, events=log,
+              labels={"bucket": "b1.s16.m32"}, req_ids=["req1", "req2"]) as sp:
+        sp.note(rows=2)
+    log.close()
+    assert sp.duration_s is not None and sp.duration_s >= 0
+    h = reg.histogram(
+        "serve_dispatch_seconds", labels={"bucket": "b1.s16.m32"}
+    )
+    assert h.count == 1
+    (rec,) = read_events(str(tmp_path))
+    assert rec["event"] == "serve_dispatch"
+    assert rec["req_ids"] == ["req1", "req2"] and rec["rows"] == 2
+    assert rec["bucket"] == "b1.s16.m32" and rec["duration_s"] >= 0
+
+
+def test_span_records_error_and_still_observes(tmp_path):
+    reg = MetricsRegistry()
+    log = JsonlEventLog(str(tmp_path))
+    with pytest.raises(ValueError):
+        with Span("op", registry=reg, events=log):
+            raise ValueError("boom")
+    log.close()
+    (rec,) = read_events(str(tmp_path))
+    assert rec["ok"] is False and rec["error"] == "ValueError"
+    assert reg.histogram("op_seconds").count == 1
+
+
+# ---------------------------------------------------------------------------
+# events CLI
+# ---------------------------------------------------------------------------
+
+
+def test_events_cli_summarize_and_filter(tmp_path, capsys):
+    log = JsonlEventLog(str(tmp_path))
+    for s in (1, 2):
+        log.emit("train_step", step=s, total_loss=2.0 / s,
+                 step_time_s=0.01, data_wait_s=0.001)
+    log.emit("checkpoint_save", step=2)
+    log.close()
+
+    buf = io.StringIO()
+    assert obs_cli.summarize(str(tmp_path), out=buf) == 0
+    text = buf.getvalue()
+    assert "train_step" in text and "2" in text
+    assert "step=2" in text and "total_loss" in text
+
+    assert obs_cli.main([str(tmp_path), "--event", "checkpoint_save"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1 and json.loads(out[0])["step"] == 2
+
+    assert obs_cli.main([str(tmp_path), "--tail", "2"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    assert [json.loads(ln)["event"] for ln in out] == [
+        "train_step", "checkpoint_save",
+    ]
+
+
+# ---------------------------------------------------------------------------
+# 4. the instrumented training smoke (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+def test_train_smoke_populates_metrics_and_event_log(
+    synthetic_preprocessed, tmp_path
+):
+    """A tiny run_training must (a) record step-time and data-wait into
+    the registry histograms, and (b) write train_step JSONL events
+    carrying the documented step/loss/step_time_s/data_wait_s fields,
+    plus the checkpoint_save record for the final flush."""
+    from tests.test_resilience import _train_config
+
+    cfg = _train_config(synthetic_preprocessed, tmp_path, total=3, save=2,
+                        log=1)
+    reg = MetricsRegistry()
+    from speakingstyle_tpu.training.trainer import run_training
+
+    state = run_training(cfg, max_steps=3, registry=reg)
+    assert int(state.step) == 3
+
+    snap = reg.snapshot()
+    assert snap["counters"]["train_steps_total"] == 3
+    assert snap["counters"]["checkpoint_saves_total"] >= 1
+    step_hist = snap["histograms"]["train_step_seconds"]
+    wait_hist = snap["histograms"]["train_data_wait_seconds"]
+    assert step_hist["count"] == 3 and step_hist["sum"] > 0
+    assert wait_hist["count"] == 3 and wait_hist["p95"] is not None
+    # the prefetcher reported its side of the pipeline too
+    assert snap["counters"]["data_prefetch_batches_total"] >= 3
+
+    log_dir = cfg.train.path.log_path
+    steps_events = list(read_events(log_dir, event="train_step"))
+    assert len(steps_events) == 3  # log_step=1
+    for rec in steps_events:
+        assert isinstance(rec["ts"], float)
+        assert rec["step"] in (1, 2, 3)
+        assert np.isfinite(rec["total_loss"])
+        assert rec["step_time_s"] >= 0
+        assert rec["data_wait_s"] >= 0
+        assert "lr" in rec
+    saves = list(read_events(log_dir, event="checkpoint_save"))
+    assert saves and saves[-1]["step"] == 3  # final tail-step flush

@@ -295,6 +295,36 @@ def test_batcher_futures_resolve_exactly_once_under_racing_shutdown():
     assert served == dispatched  # exactly-once: no result lost or duplicated
 
 
+def test_batcher_dispatch_events_carry_req_ids(tmp_path):
+    """The batcher's serve_dispatch JSONL record lists every request id
+    in the coalesced batch — the join key that makes one request's
+    records traceable through handler -> batcher -> engine."""
+    from speakingstyle_tpu.obs import JsonlEventLog, read_events
+
+    eng = FakeEngine(_serve_cfg(max_wait_ms=5.0))
+    log = JsonlEventLog(str(tmp_path))
+    with ContinuousBatcher(eng, events=log) as b:
+        assert b.submit(_req(0)).result(timeout=5) == "result:r0"
+    log.close()
+    recs = list(read_events(str(tmp_path), event="serve_dispatch"))
+    assert recs and recs[0]["req_ids"] == ["r0"]
+    assert recs[0]["rows"] == 1 and recs[0]["duration_s"] >= 0
+
+
+def test_batcher_stats_are_registry_views():
+    """occupancy/dispatched/rejected are views of the registry — the
+    snapshot a /metrics scrape sees and the attribute API agree."""
+    eng = FakeEngine(_serve_cfg(max_wait_ms=5.0))
+    with ContinuousBatcher(eng) as b:
+        b.submit(_req(0)).result(timeout=5)
+        snap = b.registry.snapshot()
+        assert snap["counters"]["serve_batches_total"] == b.dispatched == 1
+        assert snap["counters"]['serve_batch_occupancy_total{rows="1"}'] == 1
+        assert b.occupancy[1] == 1
+        lat = snap["histograms"]["serve_request_latency_seconds"]
+        assert lat["count"] == 1 and lat["p50"] is not None
+
+
 def test_fill_control_scalar_and_per_phoneme():
     out = _fill_control([2.0, np.asarray([3.0, 4.0], np.float32)], 3, 4)
     np.testing.assert_allclose(out[0], [2, 2, 2, 2])
@@ -490,6 +520,114 @@ def test_http_server_end_to_end(tiny_engine):
         conn.request("POST", "/synthesize", body=json.dumps({}))
         resp = conn.getresponse()
         assert resp.status == 400 and b"text" in resp.read()
+        conn.close()
+    finally:
+        server.shutdown()
+
+
+def test_metrics_endpoint_and_req_id_join(tiny_engine, tmp_path):
+    """GET /metrics serves Prometheus text from the engine registry —
+    compile counters, queue depth, per-bucket dispatch latency — and the
+    req_id minted by the HTTP handler joins its http_request event with
+    the batcher's serve_dispatch event (and rides error responses too).
+    /healthz must agree with the registry snapshot field-for-field: one
+    accounting path."""
+    from speakingstyle_tpu.obs import JsonlEventLog, read_events
+    from speakingstyle_tpu.serving.server import SynthesisServer, TextFrontend
+
+    ref = np.random.default_rng(0).standard_normal((20, 80)).astype(np.float32)
+    log = JsonlEventLog(str(tmp_path))
+    server = SynthesisServer(
+        tiny_engine, TextFrontend(tiny_engine.cfg, ref),
+        host="127.0.0.1", port=0, events=log,
+        profile_dir=str(tmp_path / "prof"),
+    )
+    host, port = server.address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("POST", "/synthesize", body=json.dumps({"text": "hello"}))
+        resp = conn.getresponse()
+        req_id = resp.getheader("X-Request-Id")
+        resp.read()
+        assert resp.status == 200 and req_id
+
+        conn.request("GET", "/metrics")
+        m = conn.getresponse()
+        text = m.read().decode()
+        assert m.status == 200
+        assert m.getheader("Content-Type").startswith("text/plain")
+        assert "serve_compiles_total" in text
+        assert "jax_backend_compiles_total" in text
+        assert "serve_queue_depth" in text
+        # per-bucket dispatch latency histogram (batch-1 covering bucket)
+        assert 'serve_dispatch_seconds_bucket{bucket="b1.s16.m32"' in text
+        assert 'serve_request_latency_seconds_count' in text
+
+        # /healthz is a view of the SAME snapshot
+        conn.request("GET", "/healthz")
+        health = json.loads(conn.getresponse().read())
+        snap = server.registry.snapshot()
+        assert health["compile_count"] == snap["counters"]["serve_compiles_total"]
+        assert health["dispatches"] == snap["counters"]["serve_dispatches_total"]
+        assert health["requests"] == snap["counters"]["serve_http_requests_total"]
+        assert "queue_depth" in health and "backend_compiles" in health
+
+        # error responses carry the req_id too (joinable failures)
+        conn.request("POST", "/synthesize", body=json.dumps({}))
+        bad = conn.getresponse()
+        err_id = bad.getheader("X-Request-Id")
+        body = json.loads(bad.read())
+        assert bad.status == 400 and body["id"] == err_id and err_id != req_id
+        conn.close()
+    finally:
+        server.shutdown()
+        log.close()
+    (http_rec,) = [r for r in read_events(str(tmp_path), event="http_request")
+                   if r["req_id"] == req_id]
+    assert http_rec["status"] == 200 and http_rec["duration_s"] > 0
+    (dispatch_rec,) = [
+        r for r in read_events(str(tmp_path), event="serve_dispatch")
+        if req_id in r["req_ids"]
+    ]
+    assert dispatch_rec["bucket"] == "b1.s16.m32"
+    # the failed request produced an http_request event but no dispatch
+    err_http = [r for r in read_events(str(tmp_path), event="http_request")
+                if r["req_id"] == err_id]
+    assert err_http and err_http[0]["status"] == 400
+    assert not any(err_id in r["req_ids"] for r in
+                   read_events(str(tmp_path), event="serve_dispatch"))
+
+
+def test_debug_profile_endpoint(tiny_engine, tmp_path):
+    """POST /debug/profile pulls a jax.profiler trace from the live
+    process; bad parameters are structured 400s."""
+    from speakingstyle_tpu.serving.server import SynthesisServer, TextFrontend
+
+    server = SynthesisServer(
+        tiny_engine, TextFrontend(tiny_engine.cfg, None),
+        host="127.0.0.1", port=0, profile_dir=str(tmp_path / "prof"),
+    )
+    host, port = server.address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=60)
+        conn.request("POST", "/debug/profile?seconds=0.2")
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        assert resp.status == 200, out
+        assert out["seconds"] == 0.2
+        import os
+
+        assert os.path.isdir(out["trace_dir"])
+
+        conn.request("POST", "/debug/profile?seconds=bogus")
+        resp = conn.getresponse()
+        assert resp.status == 400 and b"seconds" in resp.read()
+
+        conn.request("POST", "/debug/profile?seconds=999")
+        resp = conn.getresponse()
+        assert resp.status == 400 and b"(0, 60]" in resp.read()
         conn.close()
     finally:
         server.shutdown()
