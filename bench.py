@@ -12,8 +12,12 @@ BASELINE_NOTES.md (two independent anchors: the reference's own 1080Ti
 anecdote scaled to A100, and an A100 utilization bound over the XLA-counted
 step FLOPs — both land at 200-250k; we use the top of the range so
 vs_baseline is a lower bound). `python bench.py --flops` prints the
-compiled step's cost analysis. The ≥3x north-star corresponds to
-vs_baseline >= 3.0, i.e. >= 750k mel-frames/s/chip.
+compiled step's ProgramCard (obs/cost.py — the same cost/memory
+extraction serving and training export). `python bench.py --compare
+OLD.json [NEW.json]` is the regression gate over the BENCH_r*.json
+trajectory: diffs steps/sec and serving percentiles between two recorded
+artifacts, exits non-zero past a 10% regression. The ≥3x north-star
+corresponds to vs_baseline >= 3.0, i.e. >= 750k mel-frames/s/chip.
 
 Measured perf notes (v5e single chip, 2026-07 round 1):
   * step ≈ 6.5 TFLOP (ref-encoder 1024-ch convs + decoder k=9 FFN convs
@@ -270,17 +274,23 @@ def main(report_flops: bool = False, profile: bool = False,
     copts = json.loads(os.environ.get("BENCH_COMPILER_OPTIONS", "null"))
 
     if report_flops:
+        # thin ProgramCard consumer: the same extraction the serving
+        # engine and the trainer use (obs/cost.py), so --flops, /debug/
+        # programs, and the program_card event can never disagree on
+        # what a program costs
+        from speakingstyle_tpu.obs.cost import ProgramCard
+
         compiled = train_step.lower(state, batch, rng).compile(
             compiler_options=copts
         )
-        cost = compiled.cost_analysis()
-        cost = cost[0] if isinstance(cost, (list, tuple)) else cost
-        flops = float(cost.get("flops", float("nan")))
+        card = ProgramCard.from_compiled(compiled, name="train_step")
+        flops = card.flops if card.flops is not None else float("nan")
         out = {
             "metric": "train_step_flops",
             "value": flops,
             "unit": "FLOP/step",
             "per_frame_mflop": round(flops / (B * T_MEL) / 1e6, 1),
+            "program_card": card.as_dict(),
         }
         if copts:
             out["compiler_options"] = copts
@@ -800,6 +810,129 @@ def run_ab():
         print(line or json.dumps({"error": proc.stderr[-300:], "overrides": ov}))
 
 
+REGRESSION_THRESHOLD = 0.10
+
+
+def _absorb_record(rec, metrics):
+    """One emitted bench line -> {key: (value, direction)} entries.
+    direction "higher" = more is better (throughput), "lower" = less is
+    better (latency percentiles). Null values (guarded failures) skip."""
+    if not isinstance(rec, dict):
+        return
+    m = rec.get("metric")
+    if m in ("train_mel_frames_per_sec", "serve_sequential_batch1_qps",
+             "synthesis_realtime_factor", "hifigan_realtime_factor",
+             "serve_speedup_vs_sequential"):
+        if isinstance(rec.get("value"), (int, float)):
+            metrics[m] = (float(rec["value"]), "higher")
+    elif m == "synthesis_batch1_latency_ms":
+        if isinstance(rec.get("value"), (int, float)):
+            metrics[m] = (float(rec["value"]), "lower")
+    elif m == "serve_offered_load":
+        c = rec.get("clients")
+        if isinstance(rec.get("qps"), (int, float)):
+            metrics[f"serve_qps_{c}c"] = (float(rec["qps"]), "higher")
+        for pct in ("p50_ms", "p95_ms", "p99_ms"):
+            if isinstance(rec.get(pct), (int, float)):
+                metrics[f"serve_{pct}_{c}c"] = (float(rec[pct]), "lower")
+
+
+def _artifact_metrics(path):
+    """Extract comparable metrics from a bench artifact: either a driver
+    record ({"parsed": {...}, "tail": "..."} as the BENCH_r*.json
+    trajectory stores) or raw bench JSON-lines output."""
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    metrics = {}
+
+    def absorb_lines(blob):
+        for ln in (blob or "").splitlines():
+            ln = ln.strip()
+            if ln.startswith("{"):
+                try:
+                    _absorb_record(json.loads(ln), metrics)
+                except json.JSONDecodeError:
+                    continue
+
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        absorb_lines(text)
+        return metrics
+    if isinstance(doc, list):
+        for rec in doc:
+            _absorb_record(rec, metrics)
+    elif isinstance(doc, dict):
+        _absorb_record(doc, metrics)
+        _absorb_record(doc.get("parsed"), metrics)
+        absorb_lines(doc.get("tail"))
+    return metrics
+
+
+def _latest_artifact(exclude):
+    """Newest BENCH_r<N>.json next to this file, excluding ``exclude``."""
+    import glob
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    best, best_n = None, -1
+    for p in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        if os.path.abspath(p) == os.path.abspath(exclude):
+            continue
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        if m and int(m.group(1)) > best_n:
+            best, best_n = p, int(m.group(1))
+    return best
+
+
+def run_compare(old_path, new_path=None, threshold=REGRESSION_THRESHOLD,
+                out=sys.stdout):
+    """The regression gate over the BENCH_r*.json trajectory: diff every
+    comparable metric between two artifacts and exit non-zero when any
+    regresses by more than ``threshold`` (default 10%) — throughput
+    falling or latency rising. ``new_path`` defaults to the newest
+    recorded BENCH_r*.json other than ``old_path``."""
+    if new_path is None:
+        new_path = _latest_artifact(old_path)
+        if new_path is None:
+            print("no newer BENCH_r*.json artifact found to compare "
+                  f"{old_path} against", file=out)
+            return 2
+    old = _artifact_metrics(old_path)
+    new = _artifact_metrics(new_path)
+    common = sorted(set(old) & set(new))
+    if not common:
+        print(f"no comparable metrics between {old_path} and {new_path} "
+              "(both null/failed rounds?)", file=out)
+        return 2
+    name_w = max(len(k) for k in common)
+    print(f"comparing {os.path.basename(old_path)} (old) -> "
+          f"{os.path.basename(new_path)} (new), "
+          f"threshold {threshold:.0%}", file=out)
+    print(f"{'metric':<{name_w}}  {'old':>12}  {'new':>12}  "
+          f"{'delta':>8}  verdict", file=out)
+    regressions = []
+    for key in common:
+        old_v, direction = old[key]
+        new_v, _ = new[key]
+        delta = (new_v - old_v) / old_v if old_v else 0.0
+        worse = delta < -threshold if direction == "higher" \
+            else delta > threshold
+        better = delta > threshold if direction == "higher" \
+            else delta < -threshold
+        verdict = "REGRESSION" if worse else ("improved" if better else "ok")
+        if worse:
+            regressions.append(key)
+        print(f"{key:<{name_w}}  {old_v:>12.2f}  {new_v:>12.2f}  "
+              f"{delta:>+7.1%}  {verdict}", file=out)
+    if regressions:
+        print(f"FAIL: {len(regressions)} metric(s) regressed >"
+              f"{threshold:.0%}: {', '.join(regressions)}", file=out)
+        return 1
+    print(f"OK: {len(common)} metric(s) within {threshold:.0%}", file=out)
+    return 0
+
+
 def _run_guarded():
     """Run the measurement in a timeout-guarded child and ALWAYS print one
     JSON line.
@@ -886,6 +1019,18 @@ if __name__ == "__main__":
         run_serve(duration=dur)
     elif "--ab" in sys.argv:
         run_ab()
+    elif "--compare" in sys.argv:
+        i = sys.argv.index("--compare")
+        rest = [a for a in sys.argv[i + 1:] if not a.startswith("--")]
+        if not rest:
+            print("usage: bench.py --compare OLD.json [NEW.json] "
+                  "[--threshold 0.10]", file=sys.stderr)
+            sys.exit(2)
+        thr = (float(sys.argv[sys.argv.index("--threshold") + 1])
+               if "--threshold" in sys.argv else REGRESSION_THRESHOLD)
+        sys.exit(run_compare(
+            rest[0], rest[1] if len(rest) > 1 else None, threshold=thr
+        ))
     elif "--inner" in sys.argv:
         ov = None
         if "--overrides" in sys.argv:

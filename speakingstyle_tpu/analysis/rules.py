@@ -24,6 +24,10 @@ JL008  XLA compilation in hot paths (jit/lower().compile() in loops or
 JL009  wall-clock time.time() used for duration measurement
        (monotonic-clock rule: durations must use time.monotonic() or
        time.perf_counter(); time.time() is for timestamps only)
+JL010  jitted-call timing without a sync: monotonic/perf_counter
+       subtraction around a jitted call with no block_until_ready or
+       device read in the timed region — async dispatch makes such
+       timings measure enqueue cost, not execution
 """
 
 import ast
@@ -1206,6 +1210,122 @@ def rule_jl009(mod: ModuleInfo) -> Iterator[Finding]:
         )
 
 
+# ---------------------------------------------------------------------------
+# JL010 — jitted-call timing without a device sync
+# ---------------------------------------------------------------------------
+
+_MONO_CLOCK_CALLS = {"time.monotonic", "time.perf_counter"}
+# calls that force the device to catch up (or read a result back) —
+# any of these inside the timed region makes the timing device-honest
+_SYNC_CALL_NAMES = {
+    "jax.block_until_ready", "block_until_ready", "jax.device_get",
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+}
+
+
+def _jl010_jitted_names(mod: ModuleInfo, fn: ast.FunctionDef) -> Set[str]:
+    """Names in/visible-to ``fn`` bound to jit-compiled callables: passed
+    to a jax transform anywhere in the file, assigned from ``jax.jit(...)``,
+    assigned from an AOT ``.lower(...).compile()`` chain, or locally
+    ``@jax.jit``-decorated."""
+    jitted = set(mod._jitted_names)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            if _dotted(node.value.func) in _TRACING_TRANSFORMS or \
+                    _is_aot_compile_chain(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jitted.add(t.id)
+    for sub in ast.walk(fn):
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
+                sub in mod._traced:
+            jitted.add(sub.name)
+    return jitted
+
+
+def _jl010_is_sync(node: ast.Call) -> bool:
+    callee = _dotted(node.func)
+    if callee in _SYNC_CALL_NAMES:
+        return True
+    if isinstance(node.func, ast.Attribute) and node.func.attr in (
+        "item", "block_until_ready"
+    ):
+        return True
+    # float(x)/int(x) on a non-constant is a device->host read when x is
+    # a device value — the repo's sanctioned explicit-sync idiom
+    if isinstance(node.func, ast.Name) and node.func.id in ("float", "int"):
+        return bool(node.args) and not isinstance(node.args[0], ast.Constant)
+    return False
+
+
+def rule_jl010(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL010: a monotonic-clock duration (``time.monotonic()``/
+    ``time.perf_counter()`` subtraction) measured around a jitted call
+    with no device sync in the timed region — no
+    ``(jax.)block_until_ready``, no ``.item()``/``float()``/
+    ``np.asarray``/``device_get`` read of a result.
+
+    jax dispatch is asynchronous: the call returns once the work is
+    *enqueued*, so the subtraction times the host's enqueue cost, not
+    the device's execution — such numbers are reproducibly, confidently
+    wrong (often 100x). Read a result back or ``block_until_ready``
+    inside the region, or time at a boundary that already syncs.
+    """
+    for fn in mod.functions:
+        jitted = _jl010_jitted_names(mod, fn)
+        if not jitted:
+            continue
+        stamp_lines: Dict[str, List[int]] = {}   # name -> clock-assign lines
+        jit_lines: List[int] = []
+        sync_lines: List[int] = []
+        subs: List[Tuple[int, str]] = []         # (line, stamp name)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ) and _dotted(node.value.func) in _MONO_CLOCK_CALLS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        stamp_lines.setdefault(t.id, []).append(node.lineno)
+            elif isinstance(node, ast.Call):
+                if _jl010_is_sync(node):
+                    sync_lines.append(node.lineno)
+                elif isinstance(node.func, ast.Name) and \
+                        node.func.id in jitted:
+                    jit_lines.append(node.lineno)
+            if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                for side in (node.left, node.right):
+                    if isinstance(side, ast.Name) and side.id in stamp_lines:
+                        subs.append((node.lineno, side.id))
+        qual = mod.qualname(fn)
+        reported: Set[Tuple[int, str]] = set()
+        for line, stamp in subs:
+            starts = [s for s in stamp_lines[stamp] if s < line]
+            if not starts:
+                continue
+            start = max(starts)  # the stamp assignment this delta closes
+            if not any(start < l <= line for l in jit_lines):
+                continue
+            if any(start < l <= line for l in sync_lines):
+                continue
+            if (start, stamp) in reported:
+                continue
+            reported.add((start, stamp))
+            yield Finding(
+                rule="JL010",
+                path=mod.path,
+                line=line,
+                context=qual,
+                detail=f"unsynced jitted-call timing via {stamp!r}",
+                message=(
+                    f"duration from {stamp!r} in {qual} times a jitted "
+                    "call with no sync in the region: async dispatch "
+                    "returns at enqueue, so this measures host overhead, "
+                    "not execution — block_until_ready (or read a result "
+                    "back) before taking the end timestamp."
+                ),
+            )
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -1216,4 +1336,5 @@ RULES = {
     "JL007": rule_jl007,
     "JL008": rule_jl008,
     "JL009": rule_jl009,
+    "JL010": rule_jl010,
 }

@@ -11,7 +11,10 @@ lattice (``serve.*`` config block), then serves:
                        its post-startup value: steady state never compiles)
   GET  /metrics     -> Prometheus text: the same registry snapshot
                        (compile counters, queue depth, per-bucket dispatch
-                       latency histograms)
+                       latency histograms, program FLOPs/peak-bytes gauges,
+                       achieved-FLOP/s histograms, process RSS/uptime)
+  GET  /debug/programs -> one ProgramCard JSON per compiled XLA program
+                       (per-lattice-point FLOPs + memory accounting)
   POST /debug/profile?seconds=N -> pull a jax.profiler trace from the
                        live process (serve.debug_profile gates it)
 
@@ -93,6 +96,12 @@ def main(args):
     )
 
     cfg = config_from_args(args)
+    if cfg.train.obs.compilation_cache_dir:
+        # before the lattice precompile: a warm restart then serves its
+        # AOT programs out of the persistent cache instead of XLA
+        from speakingstyle_tpu.obs import enable_compilation_cache
+
+        enable_compilation_cache(cfg.train.obs.compilation_cache_dir)
     engine = load_engine(
         cfg, args.restore_step,
         vocoder_ckpt=args.vocoder_ckpt, griffin_lim=args.griffin_lim,
@@ -126,7 +135,7 @@ def main(args):
     host, port = server.address[:2]
     print(f"serving on http://{host}:{port} "
           "(POST /synthesize, GET /healthz, GET /metrics, "
-          "POST /debug/profile?seconds=N)", flush=True)
+          "GET /debug/programs, POST /debug/profile?seconds=N)", flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -75,6 +75,12 @@ def main(args):
     from speakingstyle_tpu.training.trainer import run_training
 
     cfg = config_from_args(args)
+    if cfg.train.obs.compilation_cache_dir:
+        # before any compile: warm restarts then skip the step compiles
+        # (cache hit/miss counts surface via the jaxmon bridge)
+        from speakingstyle_tpu.obs import enable_compilation_cache
+
+        enable_compilation_cache(cfg.train.obs.compilation_cache_dir)
     model_axis = (
         args.model_parallel
         if args.model_parallel is not None

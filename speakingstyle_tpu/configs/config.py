@@ -371,6 +371,20 @@ class ObsConfig:
     # rotation: shift events.jsonl -> .1 past this size, keep N rotated files
     events_max_bytes: int = 8_000_000
     events_keep: int = 3
+    # persistent XLA compilation cache directory ("" = disabled): wired at
+    # CLI startup for both `train` and `serve` (obs/jaxmon.py
+    # enable_compilation_cache), so warm restarts skip the AOT compiles —
+    # the jaxmon bridge counts cache hits vs requests into the registry
+    # (jax_persistent_cache_{hits,requests}_total) so /metrics
+    # distinguishes a warm start from a cold one
+    compilation_cache_dir: str = ""
+    # build a ProgramCard for the jitted train step after its first
+    # compile (obs/cost.py): emits a one-time `program_card` JSONL event
+    # and feeds the achieved-FLOP/s histogram + device-memory watermark.
+    # Costs ONE extra compile of the step program at startup (a
+    # persistent-cache hit when compilation_cache_dir is set); disable on
+    # compile-budget-critical runs
+    program_card: bool = True
 
     def __post_init__(self):
         if self.events_max_bytes <= 0:

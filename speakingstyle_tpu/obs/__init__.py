@@ -9,14 +9,31 @@ The single instrumented spine shared by training, data, and serving
   * ``events`` — rotating JSONL event log with a stable documented
     schema (the training run's structured record);
   * ``trace`` — lightweight monotonic-clock spans feeding both;
-  * ``jaxmon`` — the jax.monitoring bridge (backend compile counter +
-    scoped ``CompileMonitor`` windows).
+  * ``jaxmon`` — the jax.monitoring bridge (backend compile + persistent
+    cache counters, scoped ``CompileMonitor`` windows, the
+    ``enable_compilation_cache`` knob);
+  * ``cost`` — ``ProgramCard`` static cost/memory accounting for
+    compiled XLA executables (per-program FLOPs/bytes/peak memory,
+    achieved-FLOP/s export);
+  * ``buildinfo`` — build/runtime identity (git SHA, jax versions,
+    backend) + process RSS for /healthz and /metrics.
 
 Zero dependencies, no jax import at module scope.
 """
 
+from speakingstyle_tpu.obs.buildinfo import build_info, process_rss_bytes
+from speakingstyle_tpu.obs.cost import (
+    FLOPS_PER_SEC_BUCKETS,
+    ProgramCard,
+    device_memory_watermark,
+    publish_program_gauges,
+)
 from speakingstyle_tpu.obs.events import JsonlEventLog, read_events
-from speakingstyle_tpu.obs.jaxmon import CompileMonitor, watch_compiles
+from speakingstyle_tpu.obs.jaxmon import (
+    CompileMonitor,
+    enable_compilation_cache,
+    watch_compiles,
+)
 from speakingstyle_tpu.obs.registry import (
     DEFAULT_TIME_BUCKETS,
     Counter,
@@ -31,12 +48,19 @@ __all__ = [
     "Counter",
     "CompileMonitor",
     "DEFAULT_TIME_BUCKETS",
+    "FLOPS_PER_SEC_BUCKETS",
     "Gauge",
     "Histogram",
     "JsonlEventLog",
     "MetricsRegistry",
+    "ProgramCard",
     "Span",
+    "build_info",
+    "device_memory_watermark",
+    "enable_compilation_cache",
     "get_registry",
+    "process_rss_bytes",
+    "publish_program_gauges",
     "read_events",
     "span",
     "watch_compiles",

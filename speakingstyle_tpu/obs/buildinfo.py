@@ -1,0 +1,80 @@
+"""Build/runtime identity + process gauges: *what* is this process?
+
+Every scrape and every training run should identify the code and stack
+that produced it — a BENCH number or a /metrics snapshot without a git
+SHA and a jax version is unattributable a week later. ``build_info()``
+collects the identity once (git SHA when the tree is a checkout, jax /
+jaxlib versions, backend platform + device count/kind, python); the
+serving ``/healthz`` payload and the trainer's ``train_start`` event
+both carry it.
+
+``process_rss_bytes()`` reads the resident set from ``/proc/self/status``
+(falling back to ``resource.getrusage`` peak-RSS elsewhere) so
+``GET /metrics`` can export ``process_rss_bytes`` + ``process_uptime_seconds``
+— the two gauges that turn a scrape into "which process, how long up,
+how big".
+
+Everything degrades to ``None``/absent rather than raising: no git, no
+jax, no /proc must not take down a health endpoint.
+"""
+
+import os
+import platform
+import subprocess
+from typing import Dict, Optional
+
+
+def git_sha(cwd: Optional[str] = None) -> Optional[str]:
+    """HEAD commit of the tree containing this package, or None."""
+    cwd = cwd or os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            capture_output=True, text=True, timeout=5, cwd=cwd,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    sha = out.stdout.strip()
+    return sha if out.returncode == 0 and sha else None
+
+
+def build_info() -> Dict:
+    """Identity dict for /healthz and the train_start event. jax is
+    imported lazily and optional — the function works on a login node."""
+    info: Dict = {
+        "git_sha": git_sha(),
+        "python": platform.python_version(),
+    }
+    try:
+        import jax
+        import jaxlib
+
+        info["jax"] = jax.__version__
+        info["jaxlib"] = getattr(jaxlib, "__version__", None)
+        devs = jax.devices()
+        info["backend"] = devs[0].platform if devs else jax.default_backend()
+        info["device_count"] = len(devs)
+        info["device_kind"] = getattr(devs[0], "device_kind", "") if devs else ""
+    except Exception as e:
+        info["jax_error"] = f"{type(e).__name__}: {e}"
+    return info
+
+
+def process_rss_bytes() -> Optional[float]:
+    """Current resident set size in bytes (Linux /proc; peak-RSS via
+    getrusage elsewhere), or None when neither source works."""
+    try:
+        with open("/proc/self/status", encoding="ascii") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) * 1024.0
+    except (OSError, ValueError, IndexError):
+        pass
+    try:
+        import resource
+
+        peak_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+        return float(peak_kb) * 1024.0
+    except (ImportError, OSError, ValueError):  # windows / exotic libc
+        return None

@@ -7,6 +7,18 @@ Summarize (or filter) a run's JSONL event log (obs/events.py schema):
   --event NAME   dump matching records as JSONL to stdout (jq-friendly)
   --tail N       dump the last N records as JSONL
 
+``programs`` subcommand — pretty-print the run's ProgramCard records
+(the one-time ``program_card`` event the trainer emits; obs/cost.py) and
+compute roofline numbers from the recorded step times:
+
+  python -m speakingstyle_tpu.obs.cli programs LOG_DIR [--peak-flops F]
+
+  prints each card's FLOPs / bytes-accessed / arithmetic intensity and
+  memory breakdown, then divides card FLOPs by the mean recorded
+  ``step_time_s`` into achieved FLOP/s and bytes/s; ``--peak-flops``
+  (the chip's peak, e.g. 1.97e14 for v5e bf16) adds a model-FLOPs
+  utilization percentage.
+
 No jax import — safe to run on a login node against a live run's logs.
 """
 
@@ -70,7 +82,87 @@ def summarize(path, out=sys.stdout):
     return 0
 
 
+def _fmt_quantity(v, unit=""):
+    """Human-scaled number: 6.55e12 -> '6.55 T'."""
+    if v is None:
+        return "?"
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "k")):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f} {suffix}{unit}"
+    return f"{v:.2f} {unit}".rstrip()
+
+
+def build_programs_parser(parser=None):
+    parser = parser or argparse.ArgumentParser(
+        prog="python -m speakingstyle_tpu.obs.cli programs",
+        description="pretty-print program_card records + roofline ratios",
+    )
+    parser.add_argument(
+        "path", help="train.path.log_path directory or an events.jsonl file"
+    )
+    parser.add_argument(
+        "--peak-flops", type=float, default=None,
+        help="hardware peak FLOP/s; adds a model-FLOPs utilization row",
+    )
+    return parser
+
+
+def programs(path, peak_flops=None, out=None):
+    """Pretty-print every recorded ProgramCard and, where the log also
+    holds ``train_step`` records, the achieved-FLOP/s roofline numbers
+    the card + the measured step times imply."""
+    out = out if out is not None else sys.stdout  # late-bound: capturable
+    cards = list(read_events(path, event="program_card"))
+    if not cards:
+        print(f"no program_card events under {path}", file=out)
+        return 1
+    step_times = [
+        rec["step_time_s"]
+        for rec in read_events(path, event="train_step")
+        if isinstance(rec.get("step_time_s"), (int, float))
+        and rec["step_time_s"] > 0
+    ]
+    mean_step = sum(step_times) / len(step_times) if step_times else None
+    for card in cards:
+        print(f"program {card.get('name', '?')}"
+              + (" (partial)" if card.get("partial") else ""), file=out)
+        print(f"  flops            {_fmt_quantity(card.get('flops'), 'FLOP')}",
+              file=out)
+        print("  bytes accessed   "
+              f"{_fmt_quantity(card.get('bytes_accessed'), 'B')}", file=out)
+        ai = card.get("arithmetic_intensity")
+        print(f"  intensity        "
+              f"{ai:.1f} FLOP/B" if ai else "  intensity        ?", file=out)
+        print("  memory           "
+              f"args {_fmt_quantity(card.get('argument_bytes'), 'B')}, "
+              f"out {_fmt_quantity(card.get('output_bytes'), 'B')}, "
+              f"temp {_fmt_quantity(card.get('temp_bytes'), 'B')}, "
+              f"peak {_fmt_quantity(card.get('peak_bytes'), 'B')}", file=out)
+        for err in card.get("errors", []):
+            print(f"  degraded         {err}", file=out)
+        flops = card.get("flops")
+        if mean_step and flops:
+            achieved = flops / mean_step
+            print(f"  achieved         {_fmt_quantity(achieved, 'FLOP/s')} "
+                  f"(mean step {mean_step * 1e3:.1f} ms over "
+                  f"{len(step_times)} logged windows)", file=out)
+            ba = card.get("bytes_accessed")
+            if ba:
+                print("  achieved bytes   "
+                      f"{_fmt_quantity(ba / mean_step, 'B/s')}", file=out)
+            if peak_flops:
+                print(f"  utilization      {100 * achieved / peak_flops:.1f}% "
+                      f"of {_fmt_quantity(peak_flops, 'FLOP/s')} peak",
+                      file=out)
+        print(file=out)
+    return 0
+
+
 def main(argv=None):
+    argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "programs":
+        args = build_programs_parser().parse_args(argv[1:])
+        return programs(args.path, peak_flops=args.peak_flops)
     args = build_parser().parse_args(argv)
     if args.event is not None:
         for rec in read_events(args.path, event=args.event):

@@ -7,7 +7,12 @@ consumer can rely on:
   * every record carries ``ts`` (unix seconds, float — a *timestamp*;
     durations inside records are always measured with the monotonic
     clock and named ``*_s``) and ``event`` (the record type);
-  * training emits (trainer.py): ``train_step`` (step, per-loss fields,
+  * training emits (trainer.py): ``train_start`` (one per run: step,
+    total_step + the build identity — git_sha, jax/jaxlib, backend,
+    device_count; obs/buildinfo.py), ``program_card`` (one per run,
+    after the first compile: the train step's ProgramCard fields —
+    flops, bytes_accessed, argument/output/temp/peak bytes;
+    obs/cost.py), ``train_step`` (step, per-loss fields,
     ``lr``, ``step_time_s``, ``data_wait_s``, ``steps_per_sec``,
     ``mel_frames_per_sec``), ``val`` (step + per-loss fields),
     ``checkpoint_save`` (step), ``rollback`` (step, ``rollback_n``,

@@ -1,29 +1,51 @@
 """jax.monitoring bridge: backend events folded into metrics registries.
 
-jax's monitoring bus has no unregister API, so exactly ONE module-level
-listener is ever installed; everything downstream subscribes to it:
+jax's monitoring bus has no unregister API, so exactly ONE pair of
+module-level listeners is ever installed; everything downstream
+subscribes to them:
 
   * ``watch_compiles(registry)`` — every backend compile event
     increments ``jax_backend_compiles_total`` in that registry (each
     ``SynthesisEngine`` subscribes its own, so ``/metrics`` exports the
     backend's own compile count next to the engine's ``.compile()``
     bookkeeping — two independent witnesses for the zero-steady-state-
-    compiles invariant);
+    compiles invariant), and the persistent-compilation-cache events
+    count into ``jax_persistent_cache_requests_total`` /
+    ``jax_persistent_cache_hits_total`` — so a /metrics scrape
+    distinguishes a warm start (hits ≈ requests) from a cold one
+    (hits ≈ 0; misses are requests − hits);
   * ``CompileMonitor`` — a scoped counting window (``with monitor:``),
     used by the serve smoke test and ``bench.py --serve`` to assert the
     count is zero across a traffic window.
+
+``enable_compilation_cache(dir)`` wires jax's persistent compile cache
+(the ``train.obs.compilation_cache_dir`` knob, applied at CLI startup by
+both ``train`` and ``serve``) so repeated runs skip the AOT compiles the
+cache already holds.
 
 jax is imported lazily (on first install), so this module — like the
 rest of ``obs/`` — costs nothing to import in jax-free contexts
 (jaxlint, the events CLI).
 """
 
+import os
 import threading
 from typing import List
 
 from speakingstyle_tpu.obs.registry import MetricsRegistry
 
 _COMPILE_EVENT = "/jax/core/compile/backend_compile"
+# plain (count-only) events from jax's persistent compilation cache
+_CACHE_EVENT_COUNTERS = {
+    "/jax/compilation_cache/compile_requests_use_cache": (
+        "jax_persistent_cache_requests_total",
+        "compiles that consulted the persistent compilation cache",
+    ),
+    "/jax/compilation_cache/cache_hits": (
+        "jax_persistent_cache_hits_total",
+        "compiles served from the persistent compilation cache",
+    ),
+}
 
 _lock = threading.Lock()
 _installed = False
@@ -46,6 +68,17 @@ def _listener(name: str, *args, **kwargs) -> None:
         m._bump()
 
 
+def _event_listener(name: str, *args, **kwargs) -> None:
+    counter = _CACHE_EVENT_COUNTERS.get(name)
+    if counter is None:
+        return
+    cname, chelp = counter
+    with _lock:
+        regs = list(_registries)
+    for r in regs:
+        r.counter(cname, help=chelp).inc()
+
+
 def _ensure_installed() -> None:
     global _installed
     with _lock:
@@ -54,20 +87,39 @@ def _ensure_installed() -> None:
         import jax.monitoring
 
         jax.monitoring.register_event_duration_secs_listener(_listener)
+        jax.monitoring.register_event_listener(_event_listener)
         _installed = True
 
 
 def watch_compiles(registry: MetricsRegistry) -> None:
-    """Subscribe ``registry`` to backend compile events (idempotent)."""
+    """Subscribe ``registry`` to backend compile + cache events
+    (idempotent)."""
     _ensure_installed()
-    # touch the counter so /metrics exports 0 before the first compile
+    # touch the counters so /metrics exports 0 before the first compile
     registry.counter(
         "jax_backend_compiles_total",
         help="XLA backend compiles observed on the jax.monitoring bus",
     )
+    for cname, chelp in _CACHE_EVENT_COUNTERS.values():
+        registry.counter(cname, help=chelp)
     with _lock:
         if not any(r is registry for r in _registries):
             _registries.append(registry)
+
+
+def enable_compilation_cache(cache_dir: str) -> str:
+    """Point jax's persistent compilation cache at ``cache_dir`` (created
+    if missing) and drop the min-size/min-time thresholds so every
+    program — including the serving lattice's small buckets — is cached.
+    Returns the resolved directory. Call before the first compile."""
+    import jax
+
+    cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    return cache_dir
 
 
 class CompileMonitor:

@@ -26,6 +26,14 @@ Every engine owns its own ``MetricsRegistry`` (pass one to share): the
 dispatch path records per-bucket latency histograms
 (``serve_dispatch_seconds{bucket=...}``) that ``GET /metrics``,
 ``/healthz``, and ``bench.py --serve`` all read from the same snapshot.
+
+Every compile also mints a ``ProgramCard`` (obs/cost.py): XLA's own
+cost/memory analysis of the executable, published as per-bucket
+``serve_program_flops`` / ``serve_program_peak_bytes`` gauges and dumped
+whole by ``GET /debug/programs``. The dispatch path divides the cards'
+FLOPs by the measured dispatch wall time into
+``serve_achieved_flops_per_sec{bucket=...}`` — the MFU-style number that
+says how close each bucket runs to the hardware.
 """
 
 import contextlib
@@ -38,6 +46,11 @@ import numpy as np
 
 from speakingstyle_tpu.configs.config import Config
 from speakingstyle_tpu.obs import CompileMonitor, MetricsRegistry, watch_compiles
+from speakingstyle_tpu.obs.cost import (
+    FLOPS_PER_SEC_BUCKETS,
+    ProgramCard,
+    publish_program_gauges,
+)
 from speakingstyle_tpu.serving.lattice import Bucket, BucketLattice, RequestTooLarge
 from speakingstyle_tpu.training.resilience import retry_io
 
@@ -167,6 +180,12 @@ class SynthesisEngine:
         )
         self._acoustic: Dict[Bucket, object] = {}
         self._vocoder_exe: Dict[Tuple[int, int], object] = {}
+        # one ProgramCard per compiled executable, minted at compile time
+        # (cost/memory analysis only reads compiler metadata — building a
+        # card can never itself compile, so the zero-steady-state-compiles
+        # invariant is untouched)
+        self._acoustic_cards: Dict[Bucket, ProgramCard] = {}
+        self._vocoder_cards: Dict[Tuple[int, int], ProgramCard] = {}
         self._lock = threading.Lock()  # compile-on-miss exclusion
 
     @property
@@ -178,6 +197,26 @@ class SynthesisEngine:
     @property
     def dispatch_count(self) -> int:
         return int(self._dispatches.value)
+
+    def programs(self) -> List[Dict]:
+        """One JSON-ready ProgramCard dict per compiled executable —
+        acoustic programs in lattice order, then vocoder programs (the
+        ``GET /debug/programs`` payload)."""
+        out = []
+        for bucket in sorted(self._acoustic_cards, key=lambda b: b.volume):
+            out.append(self._acoustic_cards[bucket].as_dict())
+        for key in sorted(self._vocoder_cards):
+            out.append(self._vocoder_cards[key].as_dict())
+        return out
+
+    def _dispatch_flops(self, bucket: Bucket) -> Optional[float]:
+        """Total card FLOPs one dispatch at ``bucket`` executes (acoustic
+        + vocoder when present); None when the backend reported none."""
+        cards = [self._acoustic_cards.get(bucket)]
+        if self.vocoder is not None:
+            cards.append(self._vocoder_cards.get((bucket.b, bucket.t_mel)))
+        flops = [c.flops for c in cards if c is not None and c.flops]
+        return sum(flops) if flops else None
 
     # -- compilation --------------------------------------------------------
 
@@ -240,8 +279,16 @@ class SynthesisEngine:
         donate = tuple(range(1, 9)) if self.cfg.serve.donate_buffers else ()
         jitted = jax.jit(self._acoustic_fn(t), donate_argnums=donate)
         with _quiet_donation():
-            self._acoustic[bucket] = jitted.lower(*args).compile()
+            exe = jitted.lower(*args).compile()
+        self._acoustic[bucket] = exe
         self._compiles.inc()
+        label = bucket_label(bucket)
+        card = ProgramCard.from_compiled(exe, name=f"acoustic:{label}")
+        self._acoustic_cards[bucket] = card
+        publish_program_gauges(
+            self.registry, card, "serve",
+            labels={"kind": "acoustic", "bucket": label},
+        )
 
     def _compile_vocoder(self, b: int, t: int):
         import jax
@@ -257,10 +304,17 @@ class SynthesisEngine:
         donate = (1,) if self.cfg.serve.donate_buffers else ()
         jitted = jax.jit(fn, donate_argnums=donate)
         with _quiet_donation():
-            self._vocoder_exe[(b, t)] = jitted.lower(
+            exe = jitted.lower(
                 params, jax.ShapeDtypeStruct((b, t, self.n_mels), jnp.float32)
             ).compile()
+        self._vocoder_exe[(b, t)] = exe
         self._compiles.inc()
+        card = ProgramCard.from_compiled(exe, name=f"vocoder:b{b}.m{t}")
+        self._vocoder_cards[(b, t)] = card
+        publish_program_gauges(
+            self.registry, card, "serve",
+            labels={"kind": "vocoder", "bucket": f"b{b}.m{t}"},
+        )
 
     # -- admission geometry -------------------------------------------------
 
@@ -391,11 +445,24 @@ class SynthesisEngine:
         energy = np.asarray(out["energy_prediction"])
         self._dispatches.inc()
         self._request_rows.inc(n)
+        dur = time.monotonic() - t_dispatch
         self.registry.histogram(
             "serve_dispatch_seconds",
             labels={"bucket": bucket_label(bucket)},
             help="wall time of one padded device dispatch, per lattice bucket",
-        ).observe(time.monotonic() - t_dispatch)
+        ).observe(dur)
+        # achieved FLOP/s: the cards' static FLOPs over the measured wall
+        # time — a hardware-utilization number for the padded program as
+        # executed (row occupancy is serve_batch_occupancy_total's job)
+        flops = self._dispatch_flops(bucket)
+        if flops is not None and dur > 0:
+            self.registry.histogram(
+                "serve_achieved_flops_per_sec",
+                edges=FLOPS_PER_SEC_BUCKETS,
+                labels={"bucket": bucket_label(bucket)},
+                help="ProgramCard FLOPs / measured dispatch seconds "
+                     "(MFU-style achieved rate, per lattice bucket)",
+            ).observe(flops / dur)
 
         results = []
         for i, r in enumerate(requests):

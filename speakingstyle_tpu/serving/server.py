@@ -18,8 +18,18 @@ API:
                        response (success AND error JSON), joinable with
                        the batcher's serve_dispatch span/event records
   GET  /healthz        -> JSON view of the metrics-registry snapshot
-                        (compile counter, batch occupancy, queue depth)
+                       (compile counter, batch occupancy, queue depth)
+                       plus build info (git SHA, jax/jaxlib versions,
+                       backend, device count) so every probe identifies
+                       WHAT is running
   GET  /metrics        -> Prometheus text exposition of the same registry
+                       (incl. per-bucket serve_program_flops /
+                       serve_program_peak_bytes gauges, the
+                       serve_achieved_flops_per_sec histograms, and
+                       process_rss_bytes / process_uptime_seconds)
+  GET  /debug/programs -> one ProgramCard JSON dict per compiled XLA
+                       program (obs/cost.py): FLOPs, bytes accessed,
+                       argument/output/temp/peak bytes per lattice point
   POST /debug/profile?seconds=N
                        -> capture a jax.profiler trace from the live
                        process (serve.debug_profile gates it)
@@ -43,7 +53,7 @@ from urllib.parse import parse_qs, urlparse
 import numpy as np
 
 from speakingstyle_tpu.configs.config import Config
-from speakingstyle_tpu.obs import JsonlEventLog
+from speakingstyle_tpu.obs import JsonlEventLog, build_info, process_rss_bytes
 from speakingstyle_tpu.serving.batcher import ContinuousBatcher, ShutdownError
 from speakingstyle_tpu.serving.engine import SynthesisEngine, SynthesisRequest
 from speakingstyle_tpu.serving.lattice import RequestTooLarge
@@ -188,6 +198,15 @@ class SynthesisServer:
         self._http_errors = self.registry.counter(
             "serve_http_errors_total", help="synthesize requests failed"
         )
+        # build identity is computed once (git SHA + jax versions don't
+        # change under a live server) and rides every /healthz payload
+        self.build = build_info()
+        self._rss_gauge = self.registry.gauge(
+            "process_rss_bytes", help="resident set size of this process"
+        )
+        self._uptime_gauge = self.registry.gauge(
+            "process_uptime_seconds", help="seconds since server start"
+        )
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -218,11 +237,17 @@ class SynthesisServer:
                     return self._json(200, outer.stats())
                 if self.path == "/metrics":
                     outer.batcher.refresh_gauges()
+                    outer.refresh_process_gauges()
                     return self._text(
                         200,
                         outer.registry.prometheus_text(),
                         "text/plain; version=0.0.4; charset=utf-8",
                     )
+                if self.path == "/debug/programs":
+                    return self._json(200, {
+                        "programs": outer.engine.programs(),
+                        "build": outer.build,
+                    })
                 return self._json(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
@@ -328,6 +353,14 @@ class SynthesisServer:
                 duration_s=dur,
             )
 
+    def refresh_process_gauges(self) -> None:
+        """Sample process RSS + uptime into the registry (called at
+        scrape so /metrics always exports a current value)."""
+        rss = process_rss_bytes()
+        if rss is not None:
+            self._rss_gauge.set(rss)
+        self._uptime_gauge.set(time.monotonic() - self.started)
+
     def stats(self) -> Dict:
         """The /healthz payload: a VIEW of ``registry.snapshot()``.
 
@@ -337,10 +370,12 @@ class SynthesisServer:
         own locks), so there is no second bookkeeping path to drift.
         """
         self.batcher.refresh_gauges()
+        self.refresh_process_gauges()
         snap = self.registry.snapshot()
         counters, gauges = snap["counters"], snap["gauges"]
         return {
             "uptime_s": round(time.monotonic() - self.started, 1),
+            "build": self.build,
             "lattice_points": len(self.engine.lattice),
             "compile_count": int(counters.get("serve_compiles_total", 0)),
             "backend_compiles": int(

@@ -32,6 +32,24 @@ def public_losses(losses: Dict) -> Dict:
     return {k: v for k, v in losses.items() if k not in _INTERNAL_LOSS_KEYS}
 
 
+def build_train_step_card(train_step, state, arrays, rng):
+    """ProgramCard (obs/cost.py) for the jitted train step at the given
+    batch geometry: XLA's own FLOP/bytes/memory accounting of the step
+    program. ``.lower().compile()`` does not share jax's in-memory jit
+    cache, so this costs ONE extra compile of the step program — a
+    persistent-cache hit when ``train.obs.compilation_cache_dir`` is set.
+    Returns None (with a warning) rather than ever failing the run."""
+    try:
+        compiled = train_step.lower(state, arrays, rng).compile()
+    except Exception as e:
+        print(
+            "warning: train-step program card unavailable "
+            f"({type(e).__name__}: {e})"
+        )
+        return None
+    return obs.ProgramCard.from_compiled(compiled, name="train_step")
+
+
 def _model_kwargs(arrays: Dict, teacher_forced: bool) -> Dict:
     kw = dict(
         speakers=arrays["speakers"],
@@ -250,7 +268,13 @@ def run_training(
     (``train_step``/``val``/``checkpoint_save``/``rollback``/
     ``fault_fire``/``preempt_flush``/``quarantine``; schema in
     obs/events.py) to a rotating ``events.jsonl`` under
-    ``train.path.log_path`` (``train.obs.*`` knobs).
+    ``train.path.log_path`` (``train.obs.*`` knobs). A ``train_start``
+    event records the build identity (git SHA, jax versions, backend,
+    device count); after the first step compiles, a one-time
+    ``program_card`` event records XLA's own cost/memory accounting of
+    the step program (obs/cost.py; gated by ``train.obs.program_card``),
+    which also feeds the ``train_achieved_flops_per_sec`` histogram and
+    the ``device_memory_watermark_bytes`` gauge at log boundaries.
     """
     import time
     import jax.numpy as jnp
@@ -288,6 +312,17 @@ def run_training(
     )
     fault_ctr = registry.counter(
         "faults_fired_total", help="injected faults fired (drills)"
+    )
+    flops_hist = registry.histogram(
+        "train_achieved_flops_per_sec",
+        edges=obs.FLOPS_PER_SEC_BUCKETS,
+        help="ProgramCard train-step FLOPs / per-step wall time "
+             "(host-dispatch-based; device-honest at log boundaries)",
+    )
+    mem_gauge = registry.gauge(
+        "device_memory_watermark_bytes",
+        help="device memory watermark: backend memory_stats peak where "
+             "available, else ProgramCard argument+temp bytes",
     )
 
     if cfg.train.fast_prng:
@@ -404,9 +439,20 @@ def run_training(
         logger = TrainLogger(
             cfg.train.path.log_path, registry=registry, events=events
         )
+    if logger:
+        # one identity record per run: build + runtime stack, so a log
+        # directory is attributable without the shell that launched it
+        logger.event(
+            "train_start", step=step, total_step=total_step,
+            **obs.build_info(),
+        )
     if synth_callback == "default":
         synth_callback = default_synth_callback(cfg, logger, vocoder=vocoder)
     step_rng = jax.random.PRNGKey(cfg.train.seed + 1)
+    # the train-step ProgramCard is built once, after the first step has
+    # compiled (train.obs.program_card); card_pending makes it one
+    # attempt, success or not
+    program_card, card_pending = None, cfg.train.obs.program_card
 
     # template for rollback restores: stays valid after donation consumes
     # the live buffers (see TrainState.abstract)
@@ -453,6 +499,16 @@ def run_training(
                 step_time = time.perf_counter() - t_iter - data_wait
                 step_hist.observe(step_time)
                 window_compute += step_time
+                if card_pending:
+                    card_pending = False
+                    program_card = build_train_step_card(
+                        train_step, state, arrays, step_rng
+                    )
+                    if program_card is not None and logger:
+                        logger.event("program_card", **program_card.as_dict())
+                if program_card is not None and program_card.flops \
+                        and step_time > 0:
+                    flops_hist.observe(program_card.flops / step_time)
                 window_frames += int(batch.mel_lens.sum())  # host-side, no sync
                 if trace_active and step - start_step >= profile_steps[1]:
                     jax.block_until_ready(losses["total_loss"])
@@ -503,6 +559,9 @@ def run_training(
                         window_wait = window_compute = 0.0
                         continue
                     guard.ok()
+                    watermark = obs.device_memory_watermark(program_card)
+                    if watermark is not None:
+                        mem_gauge.set(watermark)
                     if logger:
                         contracts.assert_tree_finite(
                             public_losses(losses), "train_step.losses"
@@ -647,9 +706,10 @@ class TrainLogger:
             **(timing or {}),
         )
 
-    def event(self, name: str, **fields):
+    def event(self, name: str, /, **fields):
         """Append one structured record to events.jsonl (no-op without an
-        event log attached)."""
+        event log attached). ``name`` is positional-only so records may
+        themselves carry a ``name`` field (program cards do)."""
         if self.events is not None:
             self.events.emit(name, **fields)
 

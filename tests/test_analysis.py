@@ -531,6 +531,80 @@ def test_jl009_negative_timestamp_only_use():
 
 
 # ---------------------------------------------------------------------------
+# JL010 — jitted-call timing without a device sync
+# ---------------------------------------------------------------------------
+
+
+def test_jl010_positive_unsynced_jit_timing():
+    assert "JL010" in _codes("""
+        import time
+        import jax
+
+        def bench(f, x):
+            g = jax.jit(f)
+            t0 = time.monotonic()
+            y = g(x)
+            return time.monotonic() - t0
+    """)
+
+
+def test_jl010_positive_aot_compiled_callable():
+    assert "JL010" in _codes("""
+        import time
+        import jax
+
+        def bench(f, x):
+            compiled = jax.jit(f).lower(x).compile()
+            t0 = time.perf_counter()
+            for _ in range(10):
+                y = compiled(x)
+            dt = time.perf_counter() - t0
+            return dt
+    """)
+
+
+def test_jl010_negative_block_until_ready_in_region():
+    assert "JL010" not in _codes("""
+        import time
+        import jax
+
+        def bench(f, x):
+            g = jax.jit(f)
+            t0 = time.monotonic()
+            y = g(x)
+            jax.block_until_ready(y)
+            return time.monotonic() - t0
+    """)
+
+
+def test_jl010_negative_device_read_in_region():
+    # the repo's sanctioned sync idiom: an explicit D2H scalar read
+    assert "JL010" not in _codes("""
+        import time
+        import jax
+
+        def bench(f, x):
+            g = jax.jit(f)
+            t0 = time.perf_counter()
+            for _ in range(10):
+                y = g(x)
+            float(y)
+            return time.perf_counter() - t0
+    """)
+
+
+def test_jl010_negative_non_jitted_timing():
+    assert "JL010" not in _codes("""
+        import time
+
+        def bench(load):
+            t0 = time.monotonic()
+            load()
+            return time.monotonic() - t0
+    """)
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -641,10 +715,11 @@ def test_every_rule_is_non_vacuous():
     baselined) — rules that never fire are dead weight."""
     fired = {f.rule for f in linter.lint_paths()}
     fired |= {fp.split(":", 1)[0] for fp in linter.load_baseline()}
-    # JL009 is deliberately absent: the tree already follows the
-    # monotonic-clock duration discipline (zero wall-clock subtractions,
-    # so nothing to baseline) — the desired steady state for a
-    # preventive rule; its fixtures above keep it non-vacuous.
+    # JL009 and JL010 are deliberately absent: the tree already follows
+    # the monotonic-clock duration discipline AND syncs (reads a device
+    # value back) inside every jit-timing region, so there is nothing to
+    # baseline — the desired steady state for preventive rules; their
+    # fixtures above keep them non-vacuous.
     for code in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
                  "JL007", "JL008"):
         assert code in fired, f"{code} never fires on the real tree"
@@ -673,6 +748,9 @@ def test_cli_check_exits_zero_on_repo():
               "    except Exception:\n        pass\n"),
     ("JL008", "import jax\n\ndef sweep(vs, x):\n    for v in vs:\n"
               "        jax.jit(lambda y: y * v)(x)\n"),
+    ("JL010", "import time\nimport jax\n\ndef bench(f, x):\n"
+              "    g = jax.jit(f)\n    t0 = time.monotonic()\n"
+              "    y = g(x)\n    return time.monotonic() - t0\n"),
 ])
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
     # JL004 is scoped to training/ paths; JL007 to speakingstyle_tpu/

@@ -1,0 +1,129 @@
+"""bench.py --compare: the regression gate over recorded bench artifacts.
+
+Pure-host tests (no jax): artifact-metric extraction across both stored
+formats (driver records with "parsed"/"tail", raw JSON-lines) and the
+threshold/exit-code contract of the diff table.
+"""
+
+import importlib.util
+import io
+import json
+import os
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_lines(path, records):
+    with open(path, "w") as fh:
+        for rec in records:
+            fh.write(json.dumps(rec) + "\n")
+    return str(path)
+
+
+_OLD = [
+    {"metric": "train_mel_frames_per_sec", "value": 400_000.0,
+     "unit": "mel-frames/sec/chip", "vs_baseline": 1.6},
+    {"metric": "serve_offered_load", "clients": 8, "qps": 100.0,
+     "p50_ms": 40.0, "p95_ms": 80.0, "p99_ms": 120.0},
+    {"metric": "serve_speedup_vs_sequential", "value": 4.8},
+]
+
+
+def test_artifact_metrics_from_json_lines(bench, tmp_path):
+    path = _write_lines(tmp_path / "old.json", _OLD)
+    m = bench._artifact_metrics(path)
+    assert m["train_mel_frames_per_sec"] == (400_000.0, "higher")
+    assert m["serve_qps_8c"] == (100.0, "higher")
+    assert m["serve_p95_ms_8c"] == (80.0, "lower")
+    assert m["serve_speedup_vs_sequential"] == (4.8, "higher")
+
+
+def test_artifact_metrics_from_driver_record(bench, tmp_path):
+    """The BENCH_r*.json trajectory format: one driver dict whose
+    "parsed" holds the headline line and "tail" the raw stdout; null
+    values (guarded failures) are skipped."""
+    rec = {
+        "n": 5,
+        "cmd": "python bench.py",
+        "rc": 0,
+        "tail": json.dumps(_OLD[1]) + "\n" + json.dumps(_OLD[2]) + "\n",
+        "parsed": _OLD[0],
+    }
+    path = tmp_path / "driver.json"
+    path.write_text(json.dumps(rec))
+    m = bench._artifact_metrics(str(path))
+    assert m["train_mel_frames_per_sec"] == (400_000.0, "higher")
+    assert m["serve_qps_8c"] == (100.0, "higher")
+
+    null = dict(rec, parsed={"metric": "train_mel_frames_per_sec",
+                             "value": None, "error": "timeout"}, tail="")
+    path.write_text(json.dumps(null))
+    assert bench._artifact_metrics(str(path)) == {}
+
+
+def test_compare_ok_within_threshold(bench, tmp_path):
+    old = _write_lines(tmp_path / "old.json", _OLD)
+    new = _write_lines(tmp_path / "new.json", [
+        dict(_OLD[0], value=390_000.0),          # -2.5%: fine
+        dict(_OLD[1], qps=105.0, p95_ms=84.0),   # +5% qps, +5% p95: fine
+        _OLD[2],
+    ])
+    out = io.StringIO()
+    assert bench.run_compare(old, new, out=out) == 0
+    text = out.getvalue()
+    assert "OK" in text and "REGRESSION" not in text
+    assert "train_mel_frames_per_sec" in text
+
+
+def test_compare_fails_on_throughput_regression(bench, tmp_path):
+    old = _write_lines(tmp_path / "old.json", _OLD)
+    new = _write_lines(tmp_path / "new.json", [
+        dict(_OLD[0], value=300_000.0),  # -25%: regression
+        _OLD[1],
+        _OLD[2],
+    ])
+    out = io.StringIO()
+    assert bench.run_compare(old, new, out=out) == 1
+    text = out.getvalue()
+    assert "REGRESSION" in text and "FAIL" in text
+    assert "train_mel_frames_per_sec" in text
+
+
+def test_compare_fails_on_latency_regression(bench, tmp_path):
+    """Latency is lower-is-better: a p95 that RISES past the threshold
+    fails even while every throughput number holds."""
+    old = _write_lines(tmp_path / "old.json", _OLD)
+    new = _write_lines(tmp_path / "new.json", [
+        _OLD[0],
+        dict(_OLD[1], p95_ms=120.0),  # +50% p95
+        _OLD[2],
+    ])
+    out = io.StringIO()
+    assert bench.run_compare(old, new, out=out) == 1
+    assert "serve_p95_ms_8c" in out.getvalue()
+
+
+def test_compare_no_common_metrics_is_usage_error(bench, tmp_path):
+    old = _write_lines(tmp_path / "old.json", _OLD)
+    new = _write_lines(tmp_path / "new.json",
+                       [{"metric": "something_else", "value": 1.0}])
+    out = io.StringIO()
+    assert bench.run_compare(old, new, out=out) == 2
+
+
+def test_compare_threshold_is_tunable(bench, tmp_path):
+    old = _write_lines(tmp_path / "old.json", _OLD)
+    new = _write_lines(tmp_path / "new.json", [dict(_OLD[0], value=380_000.0)])
+    out = io.StringIO()
+    assert bench.run_compare(old, new, threshold=0.10, out=out) == 0  # -5%
+    assert bench.run_compare(old, new, threshold=0.02, out=out) == 1

@@ -289,7 +289,243 @@ def test_events_cli_summarize_and_filter(tmp_path, capsys):
 
 
 # ---------------------------------------------------------------------------
-# 4. the instrumented training smoke (the acceptance criterion)
+# 4. ProgramCard — extraction + degradation paths (obs/cost.py)
+# ---------------------------------------------------------------------------
+
+
+class _GoodCompiled:
+    """Backend that reports everything (list-wrapped cost dict + the
+    CompiledMemoryStats attribute style — the shapes jax actually uses)."""
+
+    class _Mem:
+        argument_size_in_bytes = 100
+        output_size_in_bytes = 50
+        temp_size_in_bytes = 200
+        alias_size_in_bytes = 25
+        generated_code_size_in_bytes = 10
+
+    def cost_analysis(self):
+        return [{"flops": 1e9, "transcendentals": 1e6,
+                 "bytes accessed": 5e8, "bytes accessed0{}": 1e8}]
+
+    def memory_analysis(self):
+        return self._Mem()
+
+
+class _RaisingCompiled:
+    def cost_analysis(self):
+        raise RuntimeError("backend says no")
+
+    def memory_analysis(self):
+        raise NotImplementedError("nope")
+
+
+class _NoneCompiled:
+    def cost_analysis(self):
+        return None
+
+    def memory_analysis(self):
+        return None
+
+
+class _DictMemCompiled:
+    """Dict-returning memory_analysis with the backend's own peak."""
+
+    def cost_analysis(self):
+        return {"flops": 2e9}
+
+    def memory_analysis(self):
+        return {"argument_size_in_bytes": 10, "temp_size_in_bytes": 20,
+                "peak_memory_in_bytes": 999}
+
+
+def test_program_card_full_extraction():
+    from speakingstyle_tpu.obs import ProgramCard
+
+    card = ProgramCard.from_compiled(_GoodCompiled(), name="p")
+    assert card.flops == 1e9 and card.transcendentals == 1e6
+    assert card.bytes_accessed == 5e8
+    assert card.argument_bytes == 100 and card.temp_bytes == 200
+    # peak estimate: args + out + temp + generated - alias
+    assert card.peak_bytes == 100 + 50 + 200 + 10 - 25
+    assert not card.partial and card.errors == ()
+    assert card.arithmetic_intensity == 2.0
+    assert card.achieved_flops_per_sec(0.5) == 2e9
+    d = card.as_dict()
+    assert d["name"] == "p" and d["partial"] is False
+    json.dumps(d)  # JSON-ready
+
+
+def test_program_card_degrades_on_raising_backend():
+    from speakingstyle_tpu.obs import ProgramCard, publish_program_gauges
+
+    card = ProgramCard.from_compiled(_RaisingCompiled(), name="p")
+    assert card.partial and card.flops is None and card.peak_bytes is None
+    assert any("cost_analysis" in e for e in card.errors)
+    assert any("memory_analysis" in e for e in card.errors)
+    assert card.achieved_flops_per_sec(1.0) is None
+    json.dumps(card.as_dict())
+    # publishing a fully-degraded card is a no-op, not a crash
+    reg = MetricsRegistry()
+    publish_program_gauges(reg, card, "serve", labels={"bucket": "b1"})
+    assert reg.snapshot()["gauges"] == {}
+
+
+def test_program_card_degrades_on_none_returns():
+    from speakingstyle_tpu.obs import ProgramCard
+
+    card = ProgramCard.from_compiled(_NoneCompiled(), name="p")
+    assert card.partial and card.flops is None
+    assert any("None" in e for e in card.errors)
+
+
+def test_program_card_dict_memory_and_backend_peak():
+    from speakingstyle_tpu.obs import ProgramCard, publish_program_gauges
+
+    card = ProgramCard.from_compiled(_DictMemCompiled(), name="p")
+    assert card.flops == 2e9
+    assert card.peak_bytes == 999  # the backend's own peak wins
+    reg = MetricsRegistry()
+    publish_program_gauges(reg, card, "serve", labels={"bucket": "b1"})
+    snap = reg.snapshot()
+    assert snap["gauges"]['serve_program_flops{bucket="b1"}'] == 2e9
+    assert snap["gauges"]['serve_program_peak_bytes{bucket="b1"}'] == 999
+
+
+def test_program_card_from_real_compiled_executable():
+    """The real jax surface on CPU: a compiled program yields a usable,
+    non-partial card."""
+    import jax
+    import jax.numpy as jnp
+
+    from speakingstyle_tpu.obs import ProgramCard
+
+    f = jax.jit(lambda x: jnp.sin(x) @ x)
+    compiled = f.lower(jax.ShapeDtypeStruct((8, 8), jnp.float32)).compile()
+    card = ProgramCard.from_compiled(compiled, name="sin_matmul")
+    assert card.flops and card.flops > 0
+    assert card.bytes_accessed and card.bytes_accessed > 0
+    assert card.peak_bytes and card.peak_bytes > 0
+    assert not card.partial
+
+
+def test_device_memory_watermark_falls_back_to_card():
+    """Where the backend reports no memory_stats (CPU), the watermark
+    comes from the card's argument+temp live set; with no card either,
+    None — never a crash."""
+    import jax
+
+    from speakingstyle_tpu.obs import ProgramCard, device_memory_watermark
+
+    card = ProgramCard.from_compiled(_GoodCompiled(), name="p")
+    wm = device_memory_watermark(card)
+    assert wm is not None and wm > 0
+    if jax.local_devices()[0].memory_stats() is None:  # the CPU tier-1 case
+        assert wm == 100.0 + 200.0  # argument + temp bytes
+        none_card = ProgramCard.from_compiled(_RaisingCompiled(), name="p")
+        assert device_memory_watermark(none_card) is None
+        assert device_memory_watermark(None) is None
+
+
+# ---------------------------------------------------------------------------
+# 5. buildinfo + jaxmon cache counters
+# ---------------------------------------------------------------------------
+
+
+def test_build_info_identifies_the_stack():
+    from speakingstyle_tpu.obs import build_info
+
+    info = build_info()
+    json.dumps(info)
+    assert info["python"]
+    assert info["jax"]  # jax is importable in the test env
+    assert info["backend"] and info["device_count"] >= 1
+    # this repo is a git checkout, so the SHA resolves here
+    assert info["git_sha"] is None or len(info["git_sha"]) == 40
+
+
+def test_process_rss_is_positive():
+    from speakingstyle_tpu.obs import process_rss_bytes
+
+    rss = process_rss_bytes()
+    assert rss is not None and rss > 1e6  # a python process is >1 MB
+
+
+def test_persistent_cache_events_count_into_watched_registries():
+    """The jaxmon bridge folds the compilation-cache monitoring events
+    into every watched registry, so /metrics can tell warm from cold."""
+    import jax.monitoring
+
+    from speakingstyle_tpu.obs import watch_compiles
+
+    reg = MetricsRegistry()
+    watch_compiles(reg)
+    # counters export 0 before any event (scrape-friendly)
+    assert reg.value("jax_persistent_cache_hits_total") == 0
+    assert reg.value("jax_persistent_cache_requests_total") == 0
+    jax.monitoring.record_event(
+        "/jax/compilation_cache/compile_requests_use_cache"
+    )
+    jax.monitoring.record_event("/jax/compilation_cache/cache_hits")
+    assert reg.value("jax_persistent_cache_requests_total") == 1
+    assert reg.value("jax_persistent_cache_hits_total") == 1
+
+
+def test_enable_compilation_cache_points_jax_at_dir(tmp_path):
+    import jax
+
+    from speakingstyle_tpu.obs import enable_compilation_cache
+
+    before = jax.config.jax_compilation_cache_dir
+    try:
+        resolved = enable_compilation_cache(str(tmp_path / "cache"))
+        assert os.path.isdir(resolved)
+        assert jax.config.jax_compilation_cache_dir == resolved
+    finally:
+        jax.config.update("jax_compilation_cache_dir", before)
+
+
+# ---------------------------------------------------------------------------
+# the programs CLI
+# ---------------------------------------------------------------------------
+
+
+def test_events_cli_programs_pretty_prints_and_rooflines(tmp_path, capsys):
+    log = JsonlEventLog(str(tmp_path))
+    log.emit(
+        "program_card", name="train_step", flops=1.0e12,
+        transcendentals=1e6, bytes_accessed=5.0e9, argument_bytes=100.0,
+        output_bytes=50.0, temp_bytes=200.0, peak_bytes=350.0,
+        arithmetic_intensity=200.0, partial=False,
+    )
+    for s in (1, 2):
+        log.emit("train_step", step=s, total_loss=1.0, step_time_s=0.5,
+                 data_wait_s=0.0)
+    log.close()
+
+    assert obs_cli.main(["programs", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "train_step" in out
+    assert "1.00 TFLOP" in out           # card flops
+    assert "2.00 TFLOP/s" in out         # 1e12 / 0.5 s mean step
+    assert "intensity" in out and "200.0 FLOP/B" in out
+
+    # --peak-flops adds the utilization row: 2e12 of 4e12 = 50%
+    assert obs_cli.main(
+        ["programs", str(tmp_path), "--peak-flops", "4e12"]
+    ) == 0
+    out = capsys.readouterr().out
+    assert "50.0%" in out
+
+    # empty log: rc 1, not a crash
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert obs_cli.main(["programs", str(empty)]) == 1
+    capsys.readouterr()
+
+
+# ---------------------------------------------------------------------------
+# 6. the instrumented training smoke (the acceptance criterion)
 # ---------------------------------------------------------------------------
 
 
@@ -319,6 +555,12 @@ def test_train_smoke_populates_metrics_and_event_log(
     assert wait_hist["count"] == 3 and wait_hist["p95"] is not None
     # the prefetcher reported its side of the pipeline too
     assert snap["counters"]["data_prefetch_batches_total"] >= 3
+    # the ProgramCard layer: achieved FLOP/s observed once per step from
+    # the card built after the first compile, and the memory watermark
+    # gauge set at every log boundary (card fallback on CPU)
+    flops_hist = snap["histograms"]["train_achieved_flops_per_sec"]
+    assert flops_hist["count"] == 3 and flops_hist["p50"] > 0
+    assert snap["gauges"]["device_memory_watermark_bytes"] > 0
 
     log_dir = cfg.train.path.log_path
     steps_events = list(read_events(log_dir, event="train_step"))
@@ -332,3 +574,11 @@ def test_train_smoke_populates_metrics_and_event_log(
         assert "lr" in rec
     saves = list(read_events(log_dir, event="checkpoint_save"))
     assert saves and saves[-1]["step"] == 3  # final tail-step flush
+    # one train_start event identifying the stack that ran
+    (start,) = read_events(log_dir, event="train_start")
+    assert start["jax"] and start["backend"] and start["device_count"] >= 1
+    # one program_card event: XLA's own accounting of the step program
+    (card,) = read_events(log_dir, event="program_card")
+    assert card["name"] == "train_step"
+    assert card["flops"] > 0 and card["bytes_accessed"] > 0
+    assert card["peak_bytes"] > 0 and card["partial"] is False

@@ -563,6 +563,21 @@ def test_metrics_endpoint_and_req_id_join(tiny_engine, tmp_path):
         # per-bucket dispatch latency histogram (batch-1 covering bucket)
         assert 'serve_dispatch_seconds_bucket{bucket="b1.s16.m32"' in text
         assert 'serve_request_latency_seconds_count' in text
+        # ProgramCard gauges minted at compile time, per lattice bucket
+        assert 'serve_program_flops{bucket="b1.s16.m32",kind="acoustic"}' \
+            in text
+        assert 'serve_program_peak_bytes{bucket="b1.s16.m32",kind="acoustic"}' \
+            in text
+        # the dispatch above fed the achieved-FLOP/s (MFU-style) histogram
+        assert 'serve_achieved_flops_per_sec_count{bucket="b1.s16.m32"}' \
+            in text
+        # persistent-cache counters from the jaxmon bridge (0 on a run
+        # with no cache configured, but always exported)
+        assert "jax_persistent_cache_hits_total" in text
+        assert "jax_persistent_cache_requests_total" in text
+        # process identity gauges, sampled at scrape
+        assert "process_rss_bytes" in text
+        assert "process_uptime_seconds" in text
 
         # /healthz is a view of the SAME snapshot
         conn.request("GET", "/healthz")
@@ -572,6 +587,9 @@ def test_metrics_endpoint_and_req_id_join(tiny_engine, tmp_path):
         assert health["dispatches"] == snap["counters"]["serve_dispatches_total"]
         assert health["requests"] == snap["counters"]["serve_http_requests_total"]
         assert "queue_depth" in health and "backend_compiles" in health
+        # build identity: every probe says WHAT is running
+        assert health["build"]["jax"] and health["build"]["backend"]
+        assert health["build"]["device_count"] >= 1
 
         # error responses carry the req_id too (joinable failures)
         conn.request("POST", "/synthesize", body=json.dumps({}))
@@ -597,6 +615,55 @@ def test_metrics_endpoint_and_req_id_join(tiny_engine, tmp_path):
     assert err_http and err_http[0]["status"] == 400
     assert not any(err_id in r["req_ids"] for r in
                    read_events(str(tmp_path), event="serve_dispatch"))
+
+
+def test_engine_builds_program_cards_at_precompile(tiny_engine):
+    """Every compiled executable carries a ProgramCard: one acoustic card
+    per lattice point plus the vocoder (b, t) pairs, each with real
+    numbers on CPU — and reading them never compiled anything."""
+    progs = tiny_engine.programs()
+    acoustic = [p for p in progs if p["name"].startswith("acoustic:")]
+    vocoder = [p for p in progs if p["name"].startswith("vocoder:")]
+    assert len(acoustic) == len(tiny_engine.lattice) == 2
+    assert len(vocoder) == 2  # 2 batch buckets x 1 mel bucket
+    from speakingstyle_tpu.serving.engine import bucket_label
+
+    assert {p["name"] for p in acoustic} == {
+        f"acoustic:{bucket_label(b)}" for b in tiny_engine.lattice.points()
+    }
+    for p in progs:
+        assert p["flops"] > 0 and p["bytes_accessed"] > 0
+        assert p["peak_bytes"] > 0 and p["partial"] is False
+        json.dumps(p)
+    # the bigger batch costs more FLOPs than the smaller one
+    by_name = {p["name"]: p for p in acoustic}
+    assert by_name["acoustic:b2.s16.m32"]["flops"] > \
+        by_name["acoustic:b1.s16.m32"]["flops"]
+
+
+def test_debug_programs_endpoint(tiny_engine):
+    """GET /debug/programs dumps one JSON ProgramCard per compiled
+    program, plus the build identity."""
+    from speakingstyle_tpu.serving.server import SynthesisServer, TextFrontend
+
+    server = SynthesisServer(
+        tiny_engine, TextFrontend(tiny_engine.cfg, None),
+        host="127.0.0.1", port=0,
+    )
+    host, port = server.address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("GET", "/debug/programs")
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 200
+        assert body["programs"] == tiny_engine.programs()
+        assert len(body["programs"]) == tiny_engine.compile_count
+        assert body["build"]["backend"]
+        conn.close()
+    finally:
+        server.shutdown()
 
 
 def test_debug_profile_endpoint(tiny_engine, tmp_path):
