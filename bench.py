@@ -771,6 +771,247 @@ def run_serve(duration: float = 3.0, clients=(1, 2, 4, 8, 16, 32)):
     return best_qps / seq_qps if seq_qps else None
 
 
+def _fleet_proxy_config():
+    """The fleet-sweep CPU config: the tiny model (scheduling isolated
+    from compute, as in _tiny_serve_config) with TWO mel buckets so
+    streaming windows ride a smaller vocoder bucket than full
+    utterances, and a fleet block sized for the sweep."""
+    import dataclasses
+
+    from speakingstyle_tpu.configs.config import FleetConfig, ServeConfig
+
+    cfg = _tiny_serve_config()
+    return dataclasses.replace(cfg, serve=ServeConfig(
+        batch_buckets=[1, 2, 4, 8],
+        src_buckets=[16],
+        mel_buckets=[24, 64],
+        frames_per_phoneme=4,
+        max_wait_ms=5.0,
+        queue_depth=128,
+        fleet=FleetConfig(stream_window=8, queue_depth=256),
+    ))
+
+
+class ProxyDeviceEngine:
+    """CPU-proxy stand-in for an accelerator-backed replica.
+
+    Wraps the tiny engine and adds a GIL-released per-dispatch floor
+    (``time.sleep`` scaled by the dispatched mel bucket) serialized by a
+    per-replica lock — i.e. each replica behaves like one busy device.
+    On a single-core host the real tiny-model compute cannot
+    parallelize, so without this the sweep would measure the host core,
+    not the router; with it, the replicas-axis measures exactly what the
+    fleet router adds or costs (admission, EDF pop contention,
+    per-replica pipelines). Every emitted line carries the
+    ``tiny-cpu-proxydev`` label so these numbers can never be confused
+    with device throughput.
+    """
+
+    def __init__(self, inner, device_ms: float):
+        self._inner = inner
+        self._device_ms = device_ms
+        self._device_lock = threading.Lock()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _occupy(self, t_mel: int):
+        if self._device_ms <= 0:
+            return
+        with self._device_lock:  # one device: its work serializes
+            time.sleep(self._device_ms / 1e3
+                       * t_mel / self._inner.lattice.max_mel)
+
+    def precompile(self):
+        return self._inner.precompile()
+
+    def run(self, requests):
+        out = self._inner.run(requests)
+        if out:
+            self._occupy(out[0].bucket.t_mel)
+        return out
+
+    def vocode_window(self, mel):
+        wav = self._inner.vocode_window(mel)
+        self._occupy(self._inner.lattice.cover_window(mel.shape[0])[1])
+        return wav
+
+
+def run_fleet(duration: float = 3.0, replica_counts=(1, 2, 4),
+              clients: int = 32, device_ms: float = 20.0):
+    """Fleet sweep: replicas x offered load over the SLO router, with
+    chunked streaming — records time-to-first-audio p50/p95 alongside
+    full-utterance latency, per replica count.
+
+    Closed-loop clients submit STREAMING requests (alternating
+    interactive/batch priority classes) and consume every chunk; TTFA
+    comes from the router's own ``serve_ttfa_seconds`` histogram (what a
+    /metrics scrape reports), full-utterance latency from a bench-side
+    histogram observed at the last chunk. A CompileMonitor spans each
+    load point: steady-state fleet serving must perform ZERO compiles on
+    any replica.
+    """
+    import numpy as np
+
+    import jax
+
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.models.hifigan import Generator
+    from speakingstyle_tpu.obs import MetricsRegistry
+    from speakingstyle_tpu.serving.engine import (
+        CompileMonitor,
+        SynthesisEngine,
+        SynthesisRequest,
+    )
+    from speakingstyle_tpu.serving.fleet import FleetRouter
+
+    on_tpu = _is_tpu(jax.devices()[0])
+    if on_tpu:
+        device_ms = 0.0  # real device time: no proxy floor
+    label = "tiny-cpu-proxydev" if device_ms > 0 else (
+        "flagship" if on_tpu else "tiny-cpu"
+    )
+    _mark("building fleet model parts")
+    cfg = _fleet_proxy_config()
+    serve = cfg.serve
+    n_position = max(serve.mel_buckets[-1], serve.src_buckets[-1],
+                     cfg.model.max_seq_len) + 1
+    model = build_model(cfg, n_position=n_position)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    gen = Generator(
+        upsample_rates=(2, 2), upsample_kernel_sizes=(4, 4),
+        upsample_initial_channel=16, resblock_kernel_sizes=(3,),
+        resblock_dilation_sizes=((1,),),
+    )
+    n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+    gparams = gen.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8, n_mels), np.float32)
+    )["params"]
+    rng = np.random.default_rng(0)
+    max_len = min(serve.src_buckets[-1],
+                  serve.mel_buckets[-1] // serve.frames_per_phoneme)
+
+    def make_request(i: int, priority: str) -> SynthesisRequest:
+        L = int(rng.integers(max(4, max_len // 2), max_len + 1))
+        T_ref = int(rng.integers(8, serve.mel_buckets[-1] + 1))
+        return SynthesisRequest(
+            id=f"fleet{i}",
+            sequence=rng.integers(1, 300, L).astype(np.int32),
+            ref_mel=rng.standard_normal((T_ref, n_mels)).astype(np.float32),
+            stream=True,
+            priority=priority,
+        )
+
+    qps_by_replicas = {}
+    ttfa_ratio = None
+    all_zero_compiles = True
+    for n_replicas in replica_counts:
+        registry = MetricsRegistry()
+
+        def factory(reg):
+            return ProxyDeviceEngine(
+                SynthesisEngine(
+                    cfg, variables, vocoder=(gen, gparams), model=model,
+                    registry=reg,
+                ),
+                device_ms,
+            )
+
+        _mark(f"warming {n_replicas} replicas")
+        router = FleetRouter(factory, cfg, replicas=n_replicas,
+                             registry=registry)
+        if not router.wait_ready(timeout=600, n=n_replicas):
+            print(json.dumps({
+                "metric": "serve_fleet_load", "replicas": n_replicas,
+                "error": "replicas never became ready", "model": label,
+            }))
+            router.close()
+            continue
+        for engine in router.engines():  # first-execution transfer warmup
+            for b in engine.lattice.batch_buckets:
+                engine.run([make_request(10_000 + b * 100 + j, "batch")
+                            for j in range(b)])
+        full_hist = registry.histogram(
+            "bench_full_utterance_seconds",
+            help="submit -> last streamed chunk consumed",
+        )
+        stop_at = time.perf_counter() + duration
+        done = [0] * clients
+
+        def client(cid: int):
+            i = 0
+            while time.perf_counter() < stop_at:
+                prio = "interactive" if (cid + i) % 2 == 0 else "batch"
+                req = make_request(cid * 1_000_000 + i, prio)
+                t0 = time.monotonic()
+                try:
+                    result = router.submit(req).result(timeout=60)
+                    for _ in router.stream(result, arrival=t0):
+                        pass
+                except Exception:
+                    time.sleep(0.002)  # shed/backoff; keep offering load
+                    i += 1
+                    continue
+                full_hist.observe(time.monotonic() - t0)
+                done[cid] += 1
+                i += 1
+
+        with CompileMonitor() as mon:
+            threads = [threading.Thread(target=client, args=(c,), daemon=True)
+                       for c in range(clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            router.close()
+        ttfa = registry.histogram("serve_ttfa_seconds")
+        qps = sum(done) / dt
+        qps_by_replicas[n_replicas] = qps
+        all_zero_compiles = all_zero_compiles and mon.count == 0
+
+        def pct_ms(hist, q):
+            p = hist.percentile(q)
+            return round(1e3 * p, 1) if p is not None else None
+
+        point = {
+            "metric": "serve_fleet_load",
+            "replicas": n_replicas,
+            "clients": clients,
+            "qps": round(qps, 2),
+            "ttfa_p50_ms": pct_ms(ttfa, 0.50),
+            "ttfa_p95_ms": pct_ms(ttfa, 0.95),
+            "full_p50_ms": pct_ms(full_hist, 0.50),
+            "full_p95_ms": pct_ms(full_hist, 0.95),
+            "shed": int(registry.value("serve_shed_total")),
+            "compiles_during_serve": mon.count,
+            "proxy_device_ms": device_ms,
+            "model": label,
+        }
+        if n_replicas == replica_counts[0] and point["ttfa_p50_ms"] and \
+                point["full_p50_ms"]:
+            ttfa_ratio = round(point["ttfa_p50_ms"] / point["full_p50_ms"], 3)
+        print(json.dumps(point))
+
+    base = qps_by_replicas.get(replica_counts[0])
+    top = qps_by_replicas.get(replica_counts[-1])
+    scaling = round(top / base, 2) if base and top else None
+    print(json.dumps({
+        "metric": "serve_fleet_scaling",
+        "value": scaling,
+        "unit": f"x (QPS at {replica_counts[-1]} replicas / QPS at "
+                f"{replica_counts[0]})",
+        "qps_by_replicas": {str(k): round(v, 2)
+                            for k, v in qps_by_replicas.items()},
+        "ttfa_over_full_p50": ttfa_ratio,
+        "zero_compiles_after_warmup": all_zero_compiles,
+        "proxy_device_ms": device_ms,
+        "model": label,
+    }))
+    return scaling
+
+
 def run_ab():
     """A/B the performance knobs (README "Performance knobs"): one process
     per variant so each gets a clean backend; prints one JSON line each."""
@@ -822,7 +1063,7 @@ def _absorb_record(rec, metrics):
     m = rec.get("metric")
     if m in ("train_mel_frames_per_sec", "serve_sequential_batch1_qps",
              "synthesis_realtime_factor", "hifigan_realtime_factor",
-             "serve_speedup_vs_sequential"):
+             "serve_speedup_vs_sequential", "serve_fleet_scaling"):
         if isinstance(rec.get("value"), (int, float)):
             metrics[m] = (float(rec["value"]), "higher")
     elif m == "synthesis_batch1_latency_ms":
@@ -835,6 +1076,14 @@ def _absorb_record(rec, metrics):
         for pct in ("p50_ms", "p95_ms", "p99_ms"):
             if isinstance(rec.get(pct), (int, float)):
                 metrics[f"serve_{pct}_{c}c"] = (float(rec[pct]), "lower")
+    elif m == "serve_fleet_load":
+        r = rec.get("replicas")
+        if isinstance(rec.get("qps"), (int, float)):
+            metrics[f"fleet_qps_{r}r"] = (float(rec["qps"]), "higher")
+        for pct in ("ttfa_p50_ms", "ttfa_p95_ms", "full_p50_ms",
+                    "full_p95_ms"):
+            if isinstance(rec.get(pct), (int, float)):
+                metrics[f"fleet_{pct}_{r}r"] = (float(rec[pct]), "lower")
 
 
 def _artifact_metrics(path):
@@ -1017,6 +1266,11 @@ if __name__ == "__main__":
         dur = (float(sys.argv[sys.argv.index("--duration") + 1])
                if "--duration" in sys.argv else 3.0)
         run_serve(duration=dur)
+        run_fleet(duration=dur)
+    elif "--fleet" in sys.argv:
+        dur = (float(sys.argv[sys.argv.index("--duration") + 1])
+               if "--duration" in sys.argv else 3.0)
+        run_fleet(duration=dur)
     elif "--ab" in sys.argv:
         run_ab()
     elif "--compare" in sys.argv:

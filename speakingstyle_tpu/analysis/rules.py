@@ -28,6 +28,11 @@ JL010  jitted-call timing without a sync: monotonic/perf_counter
        subtraction around a jitted call with no block_until_ready or
        device read in the timed region — async dispatch makes such
        timings measure enqueue cost, not execution
+JL011  unbounded queues in serving code: queue.Queue()/LifoQueue()/
+       PriorityQueue() with no positive maxsize (or SimpleQueue, which
+       cannot be bounded) under speakingstyle_tpu/serving/ — an
+       unbounded admission queue makes backpressure meaningless: load
+       past capacity accumulates as latency instead of shedding
 """
 
 import ast
@@ -1326,6 +1331,76 @@ def rule_jl010(mod: ModuleInfo) -> Iterator[Finding]:
             )
 
 
+# ---------------------------------------------------------------------------
+# JL011 — unbounded queues in serving code
+# ---------------------------------------------------------------------------
+
+_BOUNDABLE_QUEUES = {
+    "queue.Queue", "Queue", "queue.LifoQueue", "LifoQueue",
+    "queue.PriorityQueue", "PriorityQueue",
+}
+_UNBOUNDABLE_QUEUES = {"queue.SimpleQueue", "SimpleQueue"}
+
+
+def rule_jl011(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL011: unbounded queue construction under
+    ``speakingstyle_tpu/serving/`` — ``queue.Queue()`` (or LifoQueue/
+    PriorityQueue) with no ``maxsize``, a constant ``maxsize <= 0``
+    (stdlib semantics: infinite), or ``queue.SimpleQueue`` (which cannot
+    be bounded at all).
+
+    Serving backpressure is a *contract*: load-shedding watermarks and
+    the 429 path only mean something if every queue between admission
+    and the device has a capacity to measure against. An unbounded queue
+    silently converts overload into unbounded latency (and memory)
+    instead of an honest shed — the exact failure mode the fleet
+    router's ``serve_shed_total`` exists to prevent. Bound the queue
+    (``queue.Queue(maxsize=...)``) and admit through a stop-aware
+    ``bounded_put`` (data/prefetch.py).
+    """
+    p = mod.path.replace("\\", "/")
+    if "speakingstyle_tpu/serving/" not in p:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func)
+        detail = None
+        if callee in _UNBOUNDABLE_QUEUES:
+            detail = f"{callee} (cannot be bounded)"
+        elif callee in _BOUNDABLE_QUEUES:
+            size = None
+            if node.args:
+                size = node.args[0]
+            for kw in node.keywords:
+                if kw.arg == "maxsize":
+                    size = kw.value
+            if size is None:
+                detail = f"{callee}() with no maxsize"
+            elif isinstance(size, ast.Constant) and (
+                not isinstance(size.value, int) or size.value <= 0
+            ):
+                detail = f"{callee}(maxsize={size.value!r})"
+        if detail is None:
+            continue
+        fn = mod.enclosing_function(node)
+        qual = mod.qualname(fn or mod.tree)
+        yield Finding(
+            rule="JL011",
+            path=mod.path,
+            line=node.lineno,
+            context=qual,
+            detail=f"unbounded {detail}",
+            message=(
+                f"unbounded queue `{detail}` in serving code ({qual}): "
+                "every serving queue must be bounded or backpressure is "
+                "meaningless — overload becomes unbounded latency/memory "
+                "instead of an honest 429 shed. Pass a positive maxsize "
+                "and enqueue via the stop-aware bounded_put."
+            ),
+        )
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -1337,4 +1412,5 @@ RULES = {
     "JL008": rule_jl008,
     "JL009": rule_jl009,
     "JL010": rule_jl010,
+    "JL011": rule_jl011,
 }

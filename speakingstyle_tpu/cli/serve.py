@@ -5,24 +5,40 @@ checkpoint named by ``--restore_step``, precompiles the full shape-bucket
 lattice (``serve.*`` config block), then serves:
 
   POST /synthesize  {"text": ..., "speaker_id"?, "pitch_control"?,
-                     "energy_control"?, "duration_control"?, "ref_audio"?}
-                    -> audio/wav
+                     "energy_control"?, "duration_control"?, "ref_audio"?,
+                     "priority"? (SLO class)}
+                    -> audio/wav (429 + Retry-After under backpressure)
+  POST /synthesize/stream -> chunked audio/wav: overlap-trimmed windows
+                       emitted as they are vocoded (serving/streaming.py)
+                       — time-to-first-audio is the first-window bound
   GET  /healthz     -> engine/batcher stats (compile counter must stay at
-                       its post-startup value: steady state never compiles)
+                       its post-startup value: steady state never
+                       compiles); 503 with per-replica lifecycle states
+                       until at least one replica finished precompile
   GET  /metrics     -> Prometheus text: the same registry snapshot
                        (compile counters, queue depth, per-bucket dispatch
                        latency histograms, program FLOPs/peak-bytes gauges,
-                       achieved-FLOP/s histograms, process RSS/uptime)
+                       achieved-FLOP/s histograms, TTFA + replica-state
+                       gauges, process RSS/uptime)
   GET  /debug/programs -> one ProgramCard JSON per compiled XLA program
                        (per-lattice-point FLOPs + memory accounting)
   POST /debug/profile?seconds=N -> pull a jax.profiler trace from the
                        live process (serve.debug_profile gates it)
+
+``--replicas N`` (or ``serve.fleet.replicas``) > 1 serves through the
+fleet router (serving/fleet.py): N replica engines warm up on background
+threads (cheap under the persistent compile cache), requests carry
+priority classes dispatched earliest-deadline-first, and queue-depth
+watermarks shed load with 429s before latency collapses. SIGTERM drains
+in-flight streams before the process exits.
 
 No reference counterpart: the reference's synthesize.py is one-shot and
 pays a fresh CUDA/compile warmup per invocation.
 """
 
 import argparse
+import signal
+import threading
 
 from speakingstyle_tpu.cli import add_config_args, config_from_args
 
@@ -47,19 +63,23 @@ def build_parser(parser=None):
                         help="override serve.host")
     parser.add_argument("--port", type=int, default=None,
                         help="override serve.port")
+    parser.add_argument(
+        "--replicas", type=int, default=None,
+        help="override serve.fleet.replicas: >1 serves through the fleet "
+             "router (per-replica engines, EDF dispatch, load shedding)",
+    )
     return parser
 
 
-def load_engine(cfg, restore_step: int, vocoder_ckpt=None, griffin_lim=False):
-    """Restore the acoustic checkpoint + vocoder and build the engine.
-
-    Shared by ``serve`` and ``synthesize`` so the CLI one-shot path and
-    the server execute the identical padded-dispatch code.
-    """
+def load_engine_parts(cfg, restore_step: int, vocoder_ckpt=None,
+                      griffin_lim=False):
+    """Restore the acoustic checkpoint + vocoder ONCE; returns the
+    (variables, vocoder, lattice, model) quadruple every replica engine
+    shares — fleet replicas differ only in their compiled programs, so
+    the host-side weights are loaded a single time."""
     import jax
 
     from speakingstyle_tpu.models.factory import build_model, init_variables
-    from speakingstyle_tpu.serving.engine import SynthesisEngine
     from speakingstyle_tpu.serving.lattice import BucketLattice
     from speakingstyle_tpu.synthesis import get_vocoder
     from speakingstyle_tpu.training.checkpoint import CheckpointManager
@@ -79,12 +99,25 @@ def load_engine(cfg, restore_step: int, vocoder_ckpt=None, griffin_lim=False):
     )
     ckpt.close()
     vocoder = None if griffin_lim else get_vocoder(cfg, vocoder_ckpt)
+    variables = {"params": state.params, "batch_stats": state.batch_stats}
+    return variables, vocoder, lattice, model
+
+
+def load_engine(cfg, restore_step: int, vocoder_ckpt=None, griffin_lim=False,
+                registry=None):
+    """Restore the acoustic checkpoint + vocoder and build one engine.
+
+    Shared by ``serve`` and ``synthesize`` so the CLI one-shot path and
+    the server execute the identical padded-dispatch code.
+    """
+    from speakingstyle_tpu.serving.engine import SynthesisEngine
+
+    variables, vocoder, lattice, model = load_engine_parts(
+        cfg, restore_step, vocoder_ckpt=vocoder_ckpt, griffin_lim=griffin_lim
+    )
     return SynthesisEngine(
-        cfg,
-        {"params": state.params, "batch_stats": state.batch_stats},
-        vocoder=vocoder,
-        lattice=lattice,
-        model=model,
+        cfg, variables, vocoder=vocoder, lattice=lattice, model=model,
+        registry=registry,
     )
 
 
@@ -102,17 +135,10 @@ def main(args):
         from speakingstyle_tpu.obs import enable_compilation_cache
 
         enable_compilation_cache(cfg.train.obs.compilation_cache_dir)
-    engine = load_engine(
-        cfg, args.restore_step,
-        vocoder_ckpt=args.vocoder_ckpt, griffin_lim=args.griffin_lim,
+    replicas = (
+        args.replicas if args.replicas is not None
+        else cfg.serve.fleet.replicas
     )
-    print(f"precompiling {len(engine.lattice)} lattice points ...", flush=True)
-    secs = engine.precompile()
-    print(
-        f"precompiled {engine.compile_count} programs in {secs:.1f}s; "
-        "steady-state serving performs zero compiles", flush=True,
-    )
-
     default_ref = (
         load_ref_mel(cfg, args.ref_audio) if args.ref_audio else None
     )
@@ -125,17 +151,76 @@ def main(args):
             max_bytes=cfg.train.obs.events_max_bytes,
             keep=cfg.train.obs.events_keep,
         )
-    server = SynthesisServer(
-        engine,
-        TextFrontend(cfg, default_ref),
-        host=args.host,
-        port=args.port,
-        events=events,
-    )
+    if replicas > 1:
+        # fleet mode: load the checkpoint once, warm replicas on
+        # background threads (persistent compile cache makes scale-up
+        # cheap) — the server binds immediately and /healthz reports 503
+        # until the first replica finishes its precompile
+        from speakingstyle_tpu.obs import MetricsRegistry
+        from speakingstyle_tpu.serving.engine import SynthesisEngine
+        from speakingstyle_tpu.serving.fleet import FleetRouter
+
+        variables, vocoder, lattice, model = load_engine_parts(
+            cfg, args.restore_step,
+            vocoder_ckpt=args.vocoder_ckpt, griffin_lim=args.griffin_lim,
+        )
+
+        def factory(registry: "MetricsRegistry") -> "SynthesisEngine":
+            return SynthesisEngine(
+                cfg, variables, vocoder=vocoder, lattice=lattice,
+                model=model, registry=registry,
+            )
+
+        router = FleetRouter(
+            factory, cfg, replicas=replicas,
+            registry=MetricsRegistry(), events=events,
+        )
+        print(
+            f"warming {replicas} replicas x {len(router.lattice)} lattice "
+            "points in the background (healthz: 503 until ready) ...",
+            flush=True,
+        )
+        server = SynthesisServer(
+            frontend=TextFrontend(cfg, default_ref),
+            host=args.host,
+            port=args.port,
+            events=events,
+            router=router,
+        )
+    else:
+        engine = load_engine(
+            cfg, args.restore_step,
+            vocoder_ckpt=args.vocoder_ckpt, griffin_lim=args.griffin_lim,
+        )
+        print(f"precompiling {len(engine.lattice)} lattice points ...",
+              flush=True)
+        secs = engine.precompile()
+        print(
+            f"precompiled {engine.compile_count} programs in {secs:.1f}s; "
+            "steady-state serving performs zero compiles", flush=True,
+        )
+        server = SynthesisServer(
+            engine,
+            TextFrontend(cfg, default_ref),
+            host=args.host,
+            port=args.port,
+            events=events,
+        )
+
+    # SIGTERM contract: stop accepting, drain in-flight streams (up to
+    # serve.fleet.drain_timeout_s), flush admitted requests, exit.
+    # shutdown() must run off the serve_forever thread.
+    def _sigterm(signum, frame):
+        print("SIGTERM: draining in-flight streams ...", flush=True)
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+
     host, port = server.address[:2]
     print(f"serving on http://{host}:{port} "
-          "(POST /synthesize, GET /healthz, GET /metrics, "
-          "GET /debug/programs, POST /debug/profile?seconds=N)", flush=True)
+          "(POST /synthesize, POST /synthesize/stream, GET /healthz, "
+          "GET /metrics, GET /debug/programs, "
+          "POST /debug/profile?seconds=N)", flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

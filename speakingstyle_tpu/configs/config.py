@@ -442,6 +442,90 @@ class TrainConfig:
 # ---------------------------------------------------------------------------
 
 @dataclass(frozen=True)
+class FleetConfig:
+    """Multi-replica fleet serving knobs (serving/fleet.py,
+    serving/streaming.py — ARCHITECTURE.md "Fleet serving & streaming").
+
+    The fleet router runs N replica engines behind one SLO-aware
+    admission queue: requests carry a priority class, the queue orders by
+    earliest SLO deadline (EDF), and queue-depth watermarks shed load
+    with HTTP 429 + Retry-After well before the queue hard-fills —
+    distinct from shutdown rejection (``serve_shed_total`` vs
+    ``serve_rejected_total``).
+    """
+
+    # replica engines behind the router (one per device, or N on one
+    # device for the CPU proxy); `cli serve --replicas N` overrides
+    replicas: int = 1
+    # bounded pending heap the router admits into (EDF-ordered); all
+    # serving queues are bounded — backpressure is meaningless otherwise
+    # (jaxlint JL011 enforces this structurally for queue.Queue)
+    queue_depth: int = 256
+    # load-shedding hysteresis as fractions of queue_depth: shedding
+    # starts when pending >= high * depth and stops once it drains to
+    # <= low * depth (two watermarks so the 429 boundary cannot flap
+    # request-by-request)
+    shed_high_watermark: float = 0.9
+    shed_low_watermark: float = 0.5
+    # Retry-After seconds advertised on a 429 shed response
+    shed_retry_after_s: float = 1.0
+    # priority classes: request "priority" -> SLO completion budget (ms);
+    # the router's EDF heap orders by arrival + this budget
+    class_deadline_ms: Dict[str, float] = field(
+        default_factory=lambda: {"interactive": 250.0, "batch": 2000.0}
+    )
+    default_class: str = "interactive"
+    # chunked streaming synthesis: emit wav in windows of this many mel
+    # frames (POST /synthesize/stream); windows ride the precompiled
+    # vocoder lattice buckets, never ad-hoc shapes
+    stream_window: int = 64
+    # mel-frame context vocoded on each side of a window and trimmed
+    # from the emitted wav; 0 = derive from the vocoder's receptive
+    # field (streaming.receptive_field_frames), which is the smallest
+    # overlap that keeps chunk seams bit-exact
+    stream_overlap: int = 0
+    # SIGTERM/shutdown waits this long for in-flight streams to finish
+    drain_timeout_s: float = 10.0
+
+    def __post_init__(self):
+        if self.replicas < 1:
+            raise ValueError(f"fleet.replicas must be >= 1, got {self.replicas}")
+        if self.queue_depth <= 0:
+            raise ValueError(
+                f"fleet.queue_depth must be > 0, got {self.queue_depth}"
+            )
+        if not (0.0 < self.shed_low_watermark <= self.shed_high_watermark <= 1.0):
+            raise ValueError(
+                "fleet watermarks must satisfy 0 < low <= high <= 1, got "
+                f"low={self.shed_low_watermark} high={self.shed_high_watermark}"
+            )
+        if not self.class_deadline_ms:
+            raise ValueError("fleet.class_deadline_ms must be non-empty")
+        for name, ms in self.class_deadline_ms.items():
+            if ms <= 0:
+                raise ValueError(
+                    f"fleet.class_deadline_ms[{name!r}] must be > 0, got {ms}"
+                )
+        if self.default_class not in self.class_deadline_ms:
+            raise ValueError(
+                f"fleet.default_class {self.default_class!r} is not a key of "
+                f"class_deadline_ms {sorted(self.class_deadline_ms)}"
+            )
+        if self.stream_window <= 0:
+            raise ValueError(
+                f"fleet.stream_window must be > 0, got {self.stream_window}"
+            )
+        if self.stream_overlap < 0:
+            raise ValueError(
+                f"fleet.stream_overlap must be >= 0, got {self.stream_overlap}"
+            )
+        if self.drain_timeout_s < 0:
+            raise ValueError(
+                f"fleet.drain_timeout_s must be >= 0, got {self.drain_timeout_s}"
+            )
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Continuous-batching synthesis server knobs (serving/engine.py,
     serving/batcher.py).
@@ -485,6 +569,8 @@ class ServeConfig:
     # emit serve_dispatch / http_request JSONL events (obs/events.py
     # schema) under train.path.log_path — req_id joins the two streams
     log_events: bool = False
+    # fleet serving: multi-replica router, SLO admission, streaming
+    fleet: FleetConfig = field(default_factory=FleetConfig)
 
     def __post_init__(self):
         for name in ("batch_buckets", "src_buckets", "mel_buckets"):

@@ -1,15 +1,21 @@
 """TPU-native text->wav serving: AOT shape-bucket lattice + continuous
-batching (see ARCHITECTURE.md "Serving").
+batching + fleet routing (see ARCHITECTURE.md "Serving" and "Fleet
+serving & streaming").
 
 Layering:
-  lattice.py  — the (batch, L_src, T_mel) bucket grid + covering lookup
-  engine.py   — AOT precompile (donated buffers) + padded dispatch
-  batcher.py  — admission queue, deadline coalescing, per-request futures
-  server.py   — stdlib HTTP front-end (POST /synthesize, GET /healthz)
+  lattice.py   — the (batch, L_src, T_mel) bucket grid + covering lookup
+  engine.py    — AOT precompile (donated buffers) + padded dispatch
+  batcher.py   — admission queue, deadline coalescing, per-request futures
+  streaming.py — overlap-trimmed wav windows over the vocoder lattice
+  fleet.py     — N replicas behind an SLO-aware EDF router with
+                 watermark load-shedding and elastic warm-up
+  server.py    — stdlib HTTP front-end (POST /synthesize,
+                 POST /synthesize/stream, GET /healthz, GET /metrics)
 """
 
 from speakingstyle_tpu.serving.batcher import (  # noqa: F401
     ContinuousBatcher,
+    Overloaded,
     ShutdownError,
 )
 from speakingstyle_tpu.serving.engine import (  # noqa: F401

@@ -43,6 +43,20 @@ class ShutdownError(RuntimeError):
     """The batcher is closed (or closing) and cannot admit the request."""
 
 
+class Overloaded(RuntimeError):
+    """Load shed: the pending queue crossed its high watermark.
+
+    Distinct from ShutdownError on purpose — the two are different
+    verdicts with different client advice (HTTP 429 + Retry-After
+    "come back shortly" vs 503 "this instance is going away") and
+    different counters (``serve_shed_total`` vs ``serve_rejected_total``).
+    """
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
 @dataclass
 class _Pending:
     request: SynthesisRequest
@@ -68,9 +82,22 @@ class ContinuousBatcher:
             serve.max_wait_ms / 1e3 if max_wait is None else max_wait
         )
         self.max_batch = max_batch or engine.lattice.max_batch
-        self._queue: "queue.Queue" = queue.Queue(
-            maxsize=queue_depth or serve.queue_depth
+        self._depth = queue_depth or serve.queue_depth
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self._depth)
+        # load-shedding hysteresis over the admission queue (the fleet
+        # router uses the same watermarks over its EDF heap): shed once
+        # occupancy crosses high * depth, readmit once it drains to
+        # low * depth — so the 429 boundary cannot flap per-request
+        fleet = getattr(serve, "fleet", None)
+        self._shed_high = (
+            fleet.shed_high_watermark * self._depth if fleet else self._depth
         )
+        self._shed_low = (
+            fleet.shed_low_watermark * self._depth if fleet else 0
+        )
+        self._retry_after = fleet.shed_retry_after_s if fleet else 1.0
+        self._shedding = False
+        self._shed_lock = threading.Lock()
         self._stopped = threading.Event()
         self._closed_lock = threading.Lock()
         self._terminal_sent = False
@@ -92,6 +119,10 @@ class ContinuousBatcher:
         )
         self._rejected_ctr = self.registry.counter(
             "serve_rejected_total", help="submits refused at/after shutdown"
+        )
+        self._shed_ctr = self.registry.counter(
+            "serve_shed_total",
+            help="submits shed by backpressure (429, NOT shutdown)",
         )
         self._latency_hist = self.registry.histogram(
             "serve_request_latency_seconds",
@@ -128,6 +159,30 @@ class ContinuousBatcher:
     def rejected(self) -> int:
         return int(self._rejected_ctr.value)
 
+    @property
+    def shed(self) -> int:
+        return int(self._shed_ctr.value)
+
+    def _check_shed(self) -> None:
+        """Watermark hysteresis over queue occupancy; raises Overloaded
+        while shedding is active. Occupancy is sampled (qsize is
+        approximate under concurrency) — the watermark gap absorbs that."""
+        depth = self._queue.qsize()
+        with self._shed_lock:
+            if self._shedding:
+                if depth <= self._shed_low:
+                    self._shedding = False
+            elif depth >= self._shed_high:
+                self._shedding = True
+            shedding = self._shedding
+        if shedding:
+            self._shed_ctr.inc()
+            raise Overloaded(
+                f"admission queue at {depth}/{self._depth} (high watermark "
+                f"{self._shed_high:g}): shedding load",
+                retry_after_s=self._retry_after,
+            )
+
     def refresh_gauges(self) -> None:
         """Sample queue occupancy into the gauge (also called at scrape)."""
         self._queue_gauge.set(self._queue.qsize())
@@ -142,7 +197,9 @@ class ContinuousBatcher:
         ShutdownError once the batcher is closed.
         """
         if self._stopped.is_set():
+            self._rejected_ctr.inc()
             raise ShutdownError("batcher is closed")
+        self._check_shed()          # raises Overloaded under backpressure
         self.engine.admit(request)  # raises RequestTooLarge early
         fut: Future = Future()
         item = _Pending(

@@ -83,6 +83,13 @@ class SynthesisRequest:
     e_control: Control = 1.0
     d_control: Control = 1.0
     arrival: float = field(default_factory=time.monotonic)
+    # streaming requests take mel-only results from the coalesced
+    # dispatch; their wav is vocoded window-by-window afterwards
+    # (serving/streaming.py), so run() never vocodes their rows
+    stream: bool = False
+    # SLO priority class (serve.fleet.class_deadline_ms key); None means
+    # the fleet's default_class — ignored by the single-engine batcher
+    priority: Optional[str] = None
 
 
 @dataclass
@@ -100,6 +107,7 @@ class SynthesisResult:
     src_len: int
     bucket: Bucket
     batch_rows: int               # real rows in the dispatch that served this
+    replica: int = -1             # fleet replica index (-1: single engine)
 
 
 @contextlib.contextmanager
@@ -197,6 +205,12 @@ class SynthesisEngine:
     @property
     def dispatch_count(self) -> int:
         return int(self._dispatches.value)
+
+    @property
+    def is_ready(self) -> bool:
+        """True once the full acoustic lattice is compiled (the replica
+        readiness predicate: /healthz reports 503 until some engine is)."""
+        return len(self._acoustic) >= len(self.lattice)
 
     def programs(self) -> List[Dict]:
         """One JSON-ready ProgramCard dict per compiled executable —
@@ -316,6 +330,45 @@ class SynthesisEngine:
             labels={"kind": "vocoder", "bucket": f"b{b}.m{t}"},
         )
 
+    # -- streaming window vocode --------------------------------------------
+
+    def vocode_window(self, mel: np.ndarray) -> np.ndarray:
+        """Vocode one mel window ``[T_w, n_mels]`` -> int16 wav
+        ``[T_w * hop]`` through the precompiled lattice.
+
+        The window is padded into the smallest ``(batch, T_mel)`` vocoder
+        bucket that covers it, so streaming chunks ride the same AOT
+        programs as full-utterance dispatches — a steady-state stream
+        performs ZERO compiles. A miss (window larger than every mel
+        bucket) raises RequestTooLarge via ``cover``; an uncompiled
+        covering bucket compiles once under the engine lock and is
+        counted, exactly like ``run``'s miss path.
+        """
+        if self.vocoder is None:
+            raise ValueError("vocode_window requires a vocoder engine")
+        if mel.ndim != 2 or mel.shape[1] != self.n_mels:
+            raise ValueError(
+                f"mel window must be [T, {self.n_mels}], got {mel.shape}"
+            )
+        t_w = mel.shape[0]
+        key = self.lattice.cover_window(t_w)
+        with self._lock:
+            if key not in self._vocoder_exe:
+                self._compile_vocoder(*key)
+        gen, params = self.vocoder
+        padded = np.zeros((key[0], key[1], self.n_mels), np.float32)
+        padded[0, :t_w] = mel
+        wav_dev = self._vocoder_exe[key](params, self._transfer(
+            {"mel": padded})["mel"])
+        # host-side row select: slicing the device array would trace a
+        # gather op — one stray backend compile per shape, which the
+        # zero-steady-state-compiles monitor rightly flags
+        wav = np.clip(
+            np.asarray(wav_dev)[0] * self.max_wav_value,
+            -self.max_wav_value, self.max_wav_value - 1,
+        ).astype(np.int16)
+        return wav[: t_w * gen.hop_factor]
+
     # -- admission geometry -------------------------------------------------
 
     def required_mel(self, req: SynthesisRequest) -> int:
@@ -422,7 +475,11 @@ class SynthesisEngine:
 
         wavs = None
         hop = 1
-        if self.vocoder is not None:
+        # streaming rows are vocoded window-by-window later
+        # (serving/streaming.py); a batch of only-stream requests skips
+        # the full-utterance vocode entirely — that skipped work IS the
+        # time-to-first-audio win
+        if self.vocoder is not None and any(not r.stream for r in requests):
             gen, params = self.vocoder
             hop = gen.hop_factor
             # donation consumes mel_out on device — read the mel back
@@ -469,7 +526,7 @@ class SynthesisEngine:
             mel_len = int(out_mel_lens[i])
             src_len = int(src_lens[i])
             wav = None
-            if wavs is not None:
+            if wavs is not None and not r.stream:
                 wav = wavs[i, : mel_len * hop]
             p_len = src_len if self._pitch_axis == "src" else mel_len
             e_len = src_len if self._energy_axis == "src" else mel_len

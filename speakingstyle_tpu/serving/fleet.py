@@ -1,0 +1,494 @@
+"""Fleet router: N replica engines behind one SLO-aware admission queue.
+
+The production shape of the serving stack (ROADMAP item 2): instead of
+one engine on one device, a ``FleetRouter`` owns N replicas — each a
+full ``SynthesisEngine`` with its own AOT-precompiled lattice — behind a
+single admission queue that knows about service-level objectives:
+
+  * **Priority classes.** Every request carries a class name
+    (``serve.fleet.class_deadline_ms`` keys, e.g. ``interactive`` /
+    ``batch``); its SLO deadline is ``arrival + class budget``.
+  * **Earliest-deadline-first dispatch.** The pending structure is a
+    bounded heap ordered by SLO deadline: whichever replica frees next
+    pops the most urgent work, so an interactive request admitted after
+    a burst of batch work still dispatches first. Coalescing within one
+    replica dispatch follows the single-engine batcher's rule (greedy
+    drain, then wait until the oldest *dispatch-by* instant,
+    ``arrival + serve.max_wait_ms``).
+  * **Explicit backpressure.** Queue-depth watermarks
+    (``shed_high_watermark``/``shed_low_watermark`` fractions of
+    ``fleet.queue_depth``, with hysteresis) shed load by raising
+    ``Overloaded`` — surfaced as HTTP 429 + Retry-After and counted in
+    ``serve_shed_total``, deliberately distinct from the shutdown path's
+    ``ShutdownError``/``serve_rejected_total``.
+  * **Elastic warm-up.** ``scale_to(n)`` adds replicas that move through
+    an explicit lifecycle — cold → warming (building + precompiling on a
+    background thread; cheap when the persistent compile cache is warm)
+    → ready → draining → stopped — published per replica as the
+    ``serve_replica_state`` gauge, and `/healthz` reports 503 until at
+    least one replica is ready so load balancers never route into a
+    compile storm.
+
+Every replica preserves the engine's zero-steady-state-compiles
+invariant independently: the router never creates programs, it only
+routes into each replica's precompiled lattice (streaming windows
+included — serving/streaming.py rides the same vocoder buckets).
+"""
+
+import heapq
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from speakingstyle_tpu.obs import JsonlEventLog, MetricsRegistry
+from speakingstyle_tpu.serving import streaming
+from speakingstyle_tpu.serving.batcher import Overloaded, ShutdownError
+from speakingstyle_tpu.serving.engine import (
+    SynthesisEngine,
+    SynthesisRequest,
+    SynthesisResult,
+    bucket_label,
+)
+from speakingstyle_tpu.serving.lattice import BucketLattice
+
+# replica lifecycle states (serve_replica_state gauge values in parens)
+COLD = "cold"          # (0) constructed, nothing compiled
+WARMING = "warming"    # (1) building the engine / precompiling the lattice
+READY = "ready"        # (2) dispatching
+DRAINING = "draining"  # (3) finishing in-flight work, admitting nothing
+STOPPED = "stopped"    # (4) worker exited
+STATE_CODE = {COLD: 0, WARMING: 1, READY: 2, DRAINING: 3, STOPPED: 4}
+
+
+@dataclass(order=True)
+class _Pending:
+    """One admitted request in the EDF heap (orders by SLO deadline)."""
+
+    slo_deadline: float
+    seq: int
+    request: SynthesisRequest = field(compare=False)
+    future: Future = field(compare=False)
+    dispatch_by: float = field(compare=False)  # coalescing deadline
+    klass: str = field(compare=False)
+
+
+class Replica:
+    """One engine plus its lifecycle state and dispatch thread."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.engine: Optional[SynthesisEngine] = None
+        self.state = COLD
+        self.error: Optional[BaseException] = None
+        self.worker: Optional[threading.Thread] = None
+
+
+class FleetRouter:
+    """SLO-aware admission + EDF dispatch over N replica engines.
+
+    ``engine_factory(registry)`` builds one (un-precompiled) replica
+    engine sharing the fleet's metrics registry; the router precompiles
+    it during warm-up. The router exposes the same ``submit -> Future``
+    surface as ``ContinuousBatcher`` so the HTTP server treats either as
+    its dispatch backend.
+    """
+
+    def __init__(
+        self,
+        engine_factory: Callable[[MetricsRegistry], SynthesisEngine],
+        cfg,
+        replicas: Optional[int] = None,
+        registry: Optional[MetricsRegistry] = None,
+        events: Optional[JsonlEventLog] = None,
+    ):
+        serve = cfg.serve
+        fleet = serve.fleet
+        self.cfg = cfg
+        self.fleet = fleet
+        self.engine_factory = engine_factory
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.events = events
+        self.lattice = BucketLattice.from_config(serve)
+        self.max_batch = self.lattice.max_batch
+        self.max_wait = serve.max_wait_ms / 1e3
+        self._frames_per_phoneme = serve.frames_per_phoneme
+
+        self._cond = threading.Condition()
+        self._heap: List[_Pending] = []
+        self._seq = 0
+        self._closing = False
+        self._shedding = False
+        self._replicas: List[Replica] = []
+        self._stream_overlap: Optional[int] = None
+
+        self._shed_ctr = self.registry.counter(
+            "serve_shed_total",
+            help="submits shed by backpressure (429, NOT shutdown)",
+        )
+        self._rejected_ctr = self.registry.counter(
+            "serve_rejected_total", help="submits refused at/after shutdown"
+        )
+        self._pending_gauge = self.registry.gauge(
+            "serve_queue_depth", help="router pending-heap occupancy"
+        )
+        self._latency_hist = self.registry.histogram(
+            "serve_request_latency_seconds",
+            help="request arrival -> result latency through the router",
+        )
+        self._ttfa_hist = self.registry.histogram(
+            "serve_ttfa_seconds",
+            help="request arrival -> first streamed wav chunk ready",
+        )
+        self.scale_to(replicas if replicas is not None else fleet.replicas)
+
+    # -- replica lifecycle --------------------------------------------------
+
+    def _set_state(self, rep: Replica, state: str) -> None:
+        """Caller must hold ``self._cond``."""
+        rep.state = state
+        self.registry.gauge(
+            "serve_replica_state",
+            labels={"replica": str(rep.index)},
+            help="replica lifecycle: 0=cold 1=warming 2=ready 3=draining "
+                 "4=stopped",
+        ).set(STATE_CODE[state])
+        if self.events is not None:
+            self.events.emit(
+                "replica_state", replica=rep.index, state=state
+            )
+        self._cond.notify_all()
+
+    def scale_to(self, n: int) -> None:
+        """Elastically grow or shrink the ready+warming replica set.
+
+        Growth spawns warm-up threads (engine build + lattice precompile
+        off the caller's thread — the persistent compile cache makes this
+        a ~seconds operation when warm); shrink marks the newest replicas
+        DRAINING: they finish their in-flight dispatch, stop pulling
+        work, and stop.
+        """
+        if n < 0:
+            raise ValueError(f"scale_to requires n >= 0, got {n}")
+        with self._cond:
+            if self._closing:
+                raise ShutdownError("router is closed")
+            live = [r for r in self._replicas
+                    if r.state in (COLD, WARMING, READY)]
+            for rep in live[n:]:          # shrink newest-first
+                if rep.state == READY:
+                    self._set_state(rep, DRAINING)
+                else:
+                    self._set_state(rep, STOPPED)
+            grow = n - len(live)
+            new = []
+            for _ in range(max(0, grow)):
+                rep = Replica(len(self._replicas))
+                self._replicas.append(rep)
+                self._set_state(rep, COLD)
+                new.append(rep)
+        for rep in new:
+            t = threading.Thread(
+                target=self._warm, args=(rep,),
+                name=f"replica-{rep.index}-warmup", daemon=True,
+            )
+            t.start()
+
+    def _warm(self, rep: Replica) -> None:
+        """Background warm-up: build the engine, precompile the lattice,
+        go READY, and start the dispatch worker."""
+        with self._cond:
+            if rep.state != COLD:   # shrunk away before warm-up began
+                return
+            self._set_state(rep, WARMING)
+        try:
+            engine = self.engine_factory(self.registry)
+            secs = engine.precompile()
+            self.registry.gauge(
+                "serve_replica_precompile_seconds",
+                labels={"replica": str(rep.index)},
+                help="wall seconds the replica's lattice precompile took",
+            ).set(secs)
+        except BaseException as e:
+            rep.error = e
+            with self._cond:
+                self._set_state(rep, STOPPED)
+            if self.events is not None:
+                self.events.emit(
+                    "replica_state", replica=rep.index, state="failed",
+                    error=type(e).__name__,
+                )
+            return
+        with self._cond:
+            if rep.state != WARMING:  # shrunk away mid-warm-up
+                return
+            rep.engine = engine
+            self._set_state(rep, READY)
+        rep.worker = threading.Thread(
+            target=self._worker, args=(rep,),
+            name=f"replica-{rep.index}-dispatch", daemon=True,
+        )
+        rep.worker.start()
+
+    def states(self) -> Dict[int, str]:
+        with self._cond:
+            return {r.index: r.state for r in self._replicas}
+
+    def ready(self) -> bool:
+        with self._cond:
+            return any(r.state == READY for r in self._replicas)
+
+    def wait_ready(self, timeout: float = 120.0,
+                   n: Optional[int] = None) -> bool:
+        """Block until ``n`` replicas are READY (default 1 — the
+        /healthz readiness bar) or warm-up can no longer get there
+        (every replica stopped, or the deadline passed)."""
+        want = 1 if n is None else n
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while True:
+                if sum(r.state == READY for r in self._replicas) >= want:
+                    return True
+                if all(r.state == STOPPED for r in self._replicas):
+                    return False
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+
+    def engines(self) -> List[SynthesisEngine]:
+        with self._cond:
+            return [r.engine for r in self._replicas if r.engine is not None]
+
+    # -- admission ----------------------------------------------------------
+
+    def _admit(self, req: SynthesisRequest) -> str:
+        """Geometry + class validation at submit time (engine-free: only
+        the lattice is consulted, so admission works while every replica
+        is still warming). Returns the resolved priority class."""
+        klass = req.priority or self.fleet.default_class
+        if klass not in self.fleet.class_deadline_ms:
+            raise ValueError(
+                f"unknown priority class {klass!r}; configured classes: "
+                f"{sorted(self.fleet.class_deadline_ms)}"
+            )
+        if req.sequence.ndim != 1 or req.ref_mel.ndim != 2:
+            raise ValueError(
+                f"request {req.id!r}: sequence must be [L] and ref_mel "
+                f"[T, n_mels], got {req.sequence.shape} / {req.ref_mel.shape}"
+            )
+        need_mel = max(
+            req.ref_mel.shape[0],
+            len(req.sequence) * self._frames_per_phoneme,
+        )
+        self.lattice.cover(1, len(req.sequence), need_mel)
+        return klass
+
+    def _check_shed(self) -> None:
+        """Watermark hysteresis; caller holds ``self._cond``."""
+        depth = len(self._heap)
+        cap = self.fleet.queue_depth
+        if self._shedding:
+            if depth <= self.fleet.shed_low_watermark * cap:
+                self._shedding = False
+        elif depth >= self.fleet.shed_high_watermark * cap:
+            self._shedding = True
+        if self._shedding:
+            self._shed_ctr.inc()
+            raise Overloaded(
+                f"fleet pending queue at {depth}/{cap} (high watermark "
+                f"{self.fleet.shed_high_watermark:g}): shedding load",
+                retry_after_s=self.fleet.shed_retry_after_s,
+            )
+
+    def submit(self, request: SynthesisRequest) -> Future:
+        """Admit one request; returns a Future resolving to its
+        SynthesisResult. Raises RequestTooLarge/ValueError on geometry,
+        Overloaded past the shed watermark, ShutdownError after close."""
+        klass = self._admit(request)
+        fut: Future = Future()
+        with self._cond:
+            if self._closing:
+                self._rejected_ctr.inc()
+                raise ShutdownError("router is closed")
+            self._check_shed()
+            budget = self.fleet.class_deadline_ms[klass] / 1e3
+            self._seq += 1
+            heapq.heappush(self._heap, _Pending(
+                slo_deadline=request.arrival + budget,
+                seq=self._seq,
+                request=request,
+                future=fut,
+                dispatch_by=request.arrival + self.max_wait,
+                klass=klass,
+            ))
+            self._pending_gauge.set(len(self._heap))
+            self.registry.counter(
+                "serve_class_requests_total", labels={"class": klass},
+                help="requests admitted per priority class",
+            ).inc()
+            self._cond.notify_all()
+        return fut
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _collect(self, rep: Replica) -> Optional[List[_Pending]]:
+        """EDF pop + coalesce for one replica. None = worker should exit
+        (draining or closed-and-drained)."""
+        with self._cond:
+            while not self._heap:
+                if rep.state != READY or self._closing:
+                    return None
+                self._cond.wait(timeout=0.5)
+            batch = [heapq.heappop(self._heap)]
+            while len(batch) < self.max_batch:
+                if self._heap:
+                    batch.append(heapq.heappop(self._heap))
+                    continue
+                if self._closing or rep.state != READY:
+                    break
+                wait = min(p.dispatch_by for p in batch) - time.monotonic()
+                if wait <= 0:
+                    break
+                self._cond.wait(timeout=wait)
+            self._pending_gauge.set(len(self._heap))
+            return batch
+
+    def _dispatch(self, rep: Replica, batch: List[_Pending]) -> None:
+        req_ids = [p.request.id for p in batch]
+        t0 = time.monotonic()
+        try:
+            results = rep.engine.run([p.request for p in batch])
+        except BaseException as e:
+            if self.events is not None:
+                self.events.emit(
+                    "fleet_dispatch", replica=rep.index, req_ids=req_ids,
+                    rows=len(batch), duration_s=time.monotonic() - t0,
+                    ok=False, error=type(e).__name__,
+                )
+            for p in batch:
+                p.future.set_exception(e)
+            return
+        now = time.monotonic()
+        self.registry.counter(
+            "serve_batch_occupancy_total", labels={"rows": str(len(batch))},
+            help="dispatches by real-row occupancy",
+        ).inc()
+        self.registry.counter(
+            "serve_replica_dispatches_total",
+            labels={"replica": str(rep.index)},
+            help="coalesced dispatches executed per replica",
+        ).inc()
+        self.registry.counter(
+            "serve_replica_requests_total",
+            labels={"replica": str(rep.index)},
+            help="requests served per replica",
+        ).inc(len(batch))
+        # engines are duck-typed in tests (the batcher's convention)
+        bucket = getattr(results[0], "bucket", None) if results else None
+        if self.events is not None:
+            self.events.emit(
+                "fleet_dispatch", replica=rep.index, req_ids=req_ids,
+                rows=len(batch),
+                bucket=bucket_label(bucket) if bucket is not None else None,
+                duration_s=now - t0,
+            )
+        for p, r in zip(batch, results):
+            r.replica = rep.index
+            self._latency_hist.observe(now - p.request.arrival)
+            if now > p.slo_deadline:
+                self.registry.counter(
+                    "serve_deadline_miss_total", labels={"class": p.klass},
+                    help="requests completed past their SLO deadline",
+                ).inc()
+            p.future.set_result(r)
+
+    def _worker(self, rep: Replica) -> None:
+        try:
+            while True:
+                batch = self._collect(rep)
+                if batch is None:
+                    break
+                self._dispatch(rep, batch)
+        except BaseException as e:  # engine errors are handled per-batch;
+            # anything here is a harness bug — fail waiters loudly
+            self._fail_pending(e)
+            raise
+        finally:
+            with self._cond:
+                self._set_state(rep, STOPPED)
+
+    def _fail_pending(self, error: BaseException) -> None:
+        with self._cond:
+            pending, self._heap = self._heap, []
+            self._pending_gauge.set(0)
+        for p in pending:
+            p.future.set_exception(
+                ShutdownError(f"fleet router closed: {error!r}")
+            )
+
+    # -- streaming ----------------------------------------------------------
+
+    def stream(
+        self, result: SynthesisResult, arrival: Optional[float] = None
+    ) -> Iterator[np.ndarray]:
+        """Yield int16 wav chunks for a dispatched result, vocoded window
+        by window on the replica that produced it (precompiled buckets —
+        zero compiles). Observes ``serve_ttfa_seconds`` at the first
+        chunk when ``arrival`` (a monotonic stamp) is given."""
+        with self._cond:
+            reps = {r.index: r for r in self._replicas}
+        rep = reps.get(result.replica)
+        if rep is None or rep.engine is None:
+            raise ValueError(
+                f"result {result.id!r} carries no live replica "
+                f"(replica={result.replica})"
+            )
+        engine = rep.engine
+        if self._stream_overlap is None:
+            gen, _ = engine.vocoder
+            self._stream_overlap = streaming.resolve_overlap(
+                self.fleet.stream_overlap, gen
+            )
+        first = True
+        for chunk in streaming.stream_wav(
+            engine, result, self.fleet.stream_window, self._stream_overlap
+        ):
+            if first and arrival is not None:
+                self._ttfa_hist.observe(time.monotonic() - arrival)
+            first = False
+            yield chunk
+
+    # -- shutdown -----------------------------------------------------------
+
+    def close(self, flush: bool = True, timeout: float = 30.0) -> None:
+        """Idempotent shutdown. ``flush=True`` lets ready workers drain
+        the pending heap; ``flush=False`` fails pending requests with
+        ShutdownError. In-flight dispatches always complete."""
+        with self._cond:
+            self._closing = True
+            # replicas still cold/warming will never be needed: stop them
+            # now so a late warm-up cannot go READY into a closed router
+            for rep in self._replicas:
+                if rep.state in (COLD, WARMING):
+                    self._set_state(rep, STOPPED)
+            workers = [r.worker for r in self._replicas if r.worker]
+            self._cond.notify_all()
+        if not flush:
+            self._fail_pending(ShutdownError("router closed"))
+        deadline = time.monotonic() + timeout
+        for w in workers:
+            w.join(timeout=max(0.0, deadline - time.monotonic()))
+        # anything still pending after the drain (no replica ever came
+        # ready, or the join timed out) must not strand its waiters
+        self._fail_pending(ShutdownError("router closed"))
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

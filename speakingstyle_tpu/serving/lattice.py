@@ -13,7 +13,7 @@ independently, so "smallest covering bucket" needs no volume tie-breaks.
 
 import bisect
 from dataclasses import dataclass
-from typing import List, Sequence
+from typing import List, Sequence, Tuple
 
 from speakingstyle_tpu.configs.config import ServeConfig
 
@@ -104,5 +104,16 @@ class BucketLattice:
         return Bucket(
             _cover_axis(self.batch_buckets, n, "batch"),
             _cover_axis(self.src_buckets, l_src, "src"),
+            _cover_axis(self.mel_buckets, t_mel, "mel"),
+        )
+
+    def cover_window(self, t_mel: int) -> Tuple[int, int]:
+        """The ``(batch, T_mel)`` vocoder-program key covering one
+        single-row mel window — the streaming path's lookup
+        (serving/streaming.py): stream windows must ride these
+        precompiled pairs, never ad-hoc shapes, or steady-state
+        streaming would compile."""
+        return (
+            _cover_axis(self.batch_buckets, 1, "batch"),
             _cover_axis(self.mel_buckets, t_mel, "mel"),
         )

@@ -10,18 +10,35 @@ work happens on the batcher's single dispatch thread against
 AOT-precompiled executables. The one jax touch in a handler is the
 /debug/profile capture hook, which only starts/stops the profiler.
 
-API:
+API (request schema — every field but "text" optional):
   POST /synthesize     {"text": ..., "speaker_id"?, "pitch_control"?,
                         "energy_control"?, "duration_control"?,
-                        "ref_audio"? (server-side wav path)}
+                        "ref_audio"? (server-side wav path),
+                        "priority"? (SLO class, a
+                        serve.fleet.class_deadline_ms key — default
+                        serve.fleet.default_class; unknown class -> 400)}
                        -> audio/wav (16-bit PCM); X-Request-Id on every
                        response (success AND error JSON), joinable with
-                       the batcher's serve_dispatch span/event records
+                       the batcher's serve_dispatch span/event records.
+                       429 + Retry-After under backpressure shed
+                       (serve_shed_total), 503 during shutdown
+                       (serve_rejected_total) — two different verdicts,
+                       two different counters
+  POST /synthesize/stream
+                       same schema -> chunked audio/wav: a streaming
+                       RIFF header, then PCM in overlap-trimmed windows
+                       as they are vocoded (serving/streaming.py), each
+                       window one precompiled lattice dispatch. Cuts
+                       time-to-first-audio to the first-window bound;
+                       serve_ttfa_seconds records it
   GET  /healthz        -> JSON view of the metrics-registry snapshot
-                       (compile counter, batch occupancy, queue depth)
-                       plus build info (git SHA, jax/jaxlib versions,
-                       backend, device count) so every probe identifies
-                       WHAT is running
+                       (compile counter, batch occupancy, queue depth,
+                       shed/rejected split) plus build info (git SHA,
+                       jax/jaxlib versions, backend, device count) so
+                       every probe identifies WHAT is running. Readiness
+                       semantics: 503 with per-replica lifecycle states
+                       until at least one replica finished precompile —
+                       load balancers never route into a compile storm
   GET  /metrics        -> Prometheus text exposition of the same registry
                        (incl. per-bucket serve_program_flops /
                        serve_program_peak_bytes gauges, the
@@ -41,6 +58,7 @@ lock-discipline gap between the write and read sides).
 """
 
 import concurrent.futures
+import contextlib
 import json
 import os
 import struct
@@ -54,7 +72,12 @@ import numpy as np
 
 from speakingstyle_tpu.configs.config import Config
 from speakingstyle_tpu.obs import JsonlEventLog, build_info, process_rss_bytes
-from speakingstyle_tpu.serving.batcher import ContinuousBatcher, ShutdownError
+from speakingstyle_tpu.serving import streaming
+from speakingstyle_tpu.serving.batcher import (
+    ContinuousBatcher,
+    Overloaded,
+    ShutdownError,
+)
 from speakingstyle_tpu.serving.engine import SynthesisEngine, SynthesisRequest
 from speakingstyle_tpu.serving.lattice import RequestTooLarge
 
@@ -67,6 +90,17 @@ def wav_bytes(wav: np.ndarray, sampling_rate: int) -> bytes:
                                  sampling_rate * 2, 2, 16)
     hdr += b"data" + struct.pack("<I", len(data))
     return hdr + data
+
+
+def wav_stream_header(sampling_rate: int) -> bytes:
+    """A RIFF/WAVE header with unknown-length size fields (0xFFFFFFFF,
+    the streaming-wav convention players accept) — sent before the first
+    PCM chunk of a chunked /synthesize/stream response."""
+    hdr = b"RIFF" + struct.pack("<I", 0xFFFFFFFF) + b"WAVE"
+    hdr += b"fmt " + struct.pack("<IHHIIHH", 16, 1, 1, sampling_rate,
+                                 sampling_rate * 2, 2, 16)
+    hdr += b"data" + struct.pack("<I", 0xFFFFFFFF)
+    return hdr
 
 
 class TextFrontend:
@@ -133,6 +167,9 @@ class TextFrontend:
                 return float(v)
             raise ValueError(f"{key} must be a number (scalar control)")
 
+        priority = payload.get("priority")
+        if priority is not None and not isinstance(priority, str):
+            raise ValueError("priority must be a string class name")
         return SynthesisRequest(
             id=req_id,
             sequence=self.sequence(text),
@@ -142,6 +179,7 @@ class TextFrontend:
             p_control=ctl("pitch_control"),
             e_control=ctl("energy_control"),
             d_control=ctl("duration_control"),
+            priority=priority,
         )
 
 
@@ -165,29 +203,61 @@ def load_ref_mel(cfg: Config, wav_path: str) -> np.ndarray:
 
 
 class SynthesisServer:
-    """Bind engine + batcher + frontend behind an HTTP socket."""
+    """Bind a dispatch backend + frontend behind an HTTP socket.
+
+    Two backends share one server: the single-engine continuous batcher
+    (pass ``engine``) and the multi-replica fleet router (pass
+    ``router``; ``engine`` may be None — replicas are built by the
+    router's warm-up threads). Both expose ``submit(request) -> Future``
+    and ``close()``.
+    """
 
     def __init__(
         self,
-        engine: SynthesisEngine,
-        frontend: TextFrontend,
+        engine: Optional[SynthesisEngine] = None,
+        frontend: Optional[TextFrontend] = None,
         host: Optional[str] = None,
         port: Optional[int] = None,
         request_timeout: float = 60.0,
         events: Optional[JsonlEventLog] = None,
         profile_dir: Optional[str] = None,
+        router=None,
     ):
-        serve = engine.cfg.serve
+        if engine is None and router is None:
+            raise ValueError("SynthesisServer needs an engine or a router")
         self.engine = engine
+        self.router = router
+        self.cfg: Config = router.cfg if router is not None else engine.cfg
+        serve = self.cfg.serve
         self.frontend = frontend
-        self.registry = engine.registry
+        self.registry = (
+            router.registry if router is not None else engine.registry
+        )
         self.events = events
-        self.batcher = ContinuousBatcher(engine, events=events)
+        if router is not None:
+            self.batcher = None
+            self.backend = router
+        else:
+            self.batcher = ContinuousBatcher(engine, events=events)
+            self.backend = self.batcher
         self.request_timeout = request_timeout
         self.started = time.monotonic()
         self.profile_dir = profile_dir or os.path.join(
-            engine.cfg.train.path.log_path, "serve_profile"
+            self.cfg.train.path.log_path, "serve_profile"
         )
+        # in-flight chunked streams, drained before shutdown completes
+        self._streams_cond = threading.Condition()
+        self._active_streams = 0
+        self._streams_gauge = self.registry.gauge(
+            "serve_active_streams", help="chunked streams currently emitting"
+        )
+        self._ttfa_hist = self.registry.histogram(
+            "serve_ttfa_seconds",
+            help="request arrival -> first streamed wav chunk ready",
+        )
+        self._stream_overlap: Optional[int] = None
+        self._shutdown_lock = threading.Lock()
+        self._shut_down = False
         self._profile_lock = threading.Lock()  # one capture at a time
         # the request-id sequence IS the request counter: Counter.inc()
         # returns the post-increment value under the metric's own lock,
@@ -210,17 +280,25 @@ class SynthesisServer:
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # chunked transfer encoding (the /synthesize/stream response)
+            # requires HTTP/1.1; every other response sets Content-Length,
+            # so persistent connections stay correct
+            protocol_version = "HTTP/1.1"
+
             # quiet the default per-request stderr line
             def log_message(self, fmt, *args):
                 pass
 
-            def _json(self, code: int, obj: Dict, req_id: Optional[str] = None):
+            def _json(self, code: int, obj: Dict, req_id: Optional[str] = None,
+                      headers: Optional[Dict[str, str]] = None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 if req_id is not None:
                     self.send_header("X-Request-Id", req_id)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -234,9 +312,16 @@ class SynthesisServer:
 
             def do_GET(self):
                 if self.path == "/healthz":
-                    return self._json(200, outer.stats())
+                    # readiness semantics: 503 until some replica finished
+                    # its precompile, so load balancers never route into a
+                    # compile storm — the body still carries the
+                    # per-replica lifecycle states for the operator
+                    return self._json(
+                        200 if outer.is_ready() else 503, outer.stats()
+                    )
                 if self.path == "/metrics":
-                    outer.batcher.refresh_gauges()
+                    if outer.batcher is not None:
+                        outer.batcher.refresh_gauges()
                     outer.refresh_process_gauges()
                     return self._text(
                         200,
@@ -245,7 +330,7 @@ class SynthesisServer:
                     )
                 if self.path == "/debug/programs":
                     return self._json(200, {
-                        "programs": outer.engine.programs(),
+                        "programs": outer.programs(),
                         "build": outer.build,
                     })
                 return self._json(404, {"error": f"no route {self.path}"})
@@ -254,21 +339,40 @@ class SynthesisServer:
                 parsed = urlparse(self.path)
                 if parsed.path == "/debug/profile":
                     return self._profile(parsed)
-                if parsed.path != "/synthesize":
-                    return self._json(404, {"error": f"no route {self.path}"})
+                if parsed.path == "/synthesize/stream":
+                    return self._synthesize(parsed, stream=True)
+                if parsed.path == "/synthesize":
+                    return self._synthesize(parsed, stream=False)
+                return self._json(404, {"error": f"no route {self.path}"})
+
+            def _synthesize(self, parsed, stream: bool):
                 # the req_id is minted HERE and rides through frontend ->
-                # batcher -> engine as SynthesisRequest.id, so one
+                # batcher/router -> engine as SynthesisRequest.id, so one
                 # request's http_request/serve_dispatch records (and the
                 # X-Request-Id the client sees, errors included) all join
                 req_id = outer.next_req_id()
                 t0 = time.monotonic()
-                status, err = 200, None
+                status, err, headers = 200, None, None
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n) or b"{}")
-                    result = outer.synthesize(payload, req_id=req_id)
+                    if stream and not outer.streaming_available():
+                        raise ValueError(
+                            "streaming requires a vocoder engine "
+                            "(--griffin_lim serves mel JSON only)"
+                        )
+                    result = outer.synthesize(
+                        payload, req_id=req_id, stream=stream
+                    )
                 except (ValueError, RequestTooLarge) as e:
                     status, err = 400, str(e)
+                except Overloaded as e:
+                    # backpressure shed: NOT the shutdown path — carries
+                    # the retry hint so well-behaved clients back off
+                    status, err = 429, str(e)
+                    headers = {
+                        "Retry-After": str(max(1, int(e.retry_after_s)))
+                    }
                 except ShutdownError as e:
                     status, err = 503, str(e)
                 # concurrent.futures.TimeoutError only aliases the builtin
@@ -278,7 +382,9 @@ class SynthesisServer:
                 if err is not None:
                     outer._request_done(req_id, parsed.path, status, t0)
                     return self._json(status, {"error": err, "id": req_id},
-                                      req_id=req_id)
+                                      req_id=req_id, headers=headers)
+                if stream:
+                    return self._stream_response(result, req_id, parsed, t0)
                 if result.wav is None:
                     # vocoder-less engine: return the mel as JSON
                     outer._request_done(req_id, parsed.path, 200, t0)
@@ -287,7 +393,7 @@ class SynthesisServer:
                         "mel_len": result.mel_len,
                         "mel": result.mel.tolist(),
                     }, req_id=req_id)
-                sr = outer.engine.cfg.preprocess.preprocessing.audio.sampling_rate
+                sr = outer.cfg.preprocess.preprocessing.audio.sampling_rate
                 body = wav_bytes(result.wav, sr)
                 outer._request_done(req_id, parsed.path, 200, t0)
                 self.send_response(200)
@@ -298,8 +404,48 @@ class SynthesisServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _stream_response(self, result, req_id, parsed, t0):
+                """Chunked audio/wav: streaming RIFF header, then PCM in
+                overlap-trimmed windows as each is vocoded."""
+                sr = outer.cfg.preprocess.preprocessing.audio.sampling_rate
+
+                def write_chunk(data: bytes):
+                    self.wfile.write(b"%X\r\n" % len(data))
+                    self.wfile.write(data)
+                    self.wfile.write(b"\r\n")
+
+                self.send_response(200)
+                self.send_header("Content-Type", "audio/wav")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.send_header("X-Request-Id", result.id)
+                self.send_header("X-Batch-Rows", str(result.batch_rows))
+                self.end_headers()
+                try:
+                    with outer.stream_scope():
+                        write_chunk(wav_stream_header(sr))
+                        for wav in outer.stream_chunks(result, arrival=t0):
+                            write_chunk(wav.tobytes())
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    # client hung up mid-stream: stop vocoding for them
+                    self.close_connection = True
+                    outer._request_done(req_id, parsed.path, 499, t0)
+                    return
+                except Exception as e:
+                    # headers are gone — the only honest signal is a
+                    # truncated chunked body (no terminal chunk)
+                    self.close_connection = True
+                    outer._request_done(req_id, parsed.path, 500, t0)
+                    if outer.events is not None:
+                        outer.events.emit(
+                            "stream_abort", req_id=req_id,
+                            error=type(e).__name__,
+                        )
+                    return
+                outer._request_done(req_id, parsed.path, 200, t0)
+
             def _profile(self, parsed):
-                if not outer.engine.cfg.serve.debug_profile:
+                if not outer.cfg.serve.debug_profile:
                     return self._json(
                         403, {"error": "serve.debug_profile is disabled"}
                     )
@@ -329,12 +475,81 @@ class SynthesisServer:
     def next_req_id(self) -> str:
         return f"req{int(self._requests.inc()):08d}"
 
-    def synthesize(self, payload: Dict, req_id: Optional[str] = None):
+    def synthesize(self, payload: Dict, req_id: Optional[str] = None,
+                   stream: bool = False):
         if req_id is None:
             req_id = self.next_req_id()
         request = self.frontend.request(req_id, payload)
-        future = self.batcher.submit(request)
+        request.stream = stream   # mel-only dispatch; windows vocode after
+        future = self.backend.submit(request)
         return future.result(timeout=self.request_timeout)
+
+    # -- streaming ----------------------------------------------------------
+
+    def streaming_available(self) -> bool:
+        """Chunked streaming needs a vocoder; a griffin_lim (mel-JSON)
+        deployment has none."""
+        if self.router is not None:
+            engines = self.router.engines()
+            return not engines or engines[0].vocoder is not None
+        return self.engine.vocoder is not None
+
+    @contextlib.contextmanager
+    def stream_scope(self):
+        """Tracks in-flight chunked streams so shutdown can drain them."""
+        with self._streams_cond:
+            self._active_streams += 1
+            self._streams_gauge.set(self._active_streams)
+        try:
+            yield
+        finally:
+            with self._streams_cond:
+                self._active_streams -= 1
+                self._streams_gauge.set(self._active_streams)
+                self._streams_cond.notify_all()
+
+    def stream_chunks(self, result, arrival: Optional[float] = None):
+        """Yield int16 wav chunk arrays for a dispatched result —
+        windowed vocode over precompiled lattice buckets (zero compiles);
+        observes serve_ttfa_seconds at the first chunk."""
+        if self.router is not None:
+            yield from self.router.stream(result, arrival=arrival)
+            return
+        engine = self.engine
+        if engine.vocoder is None:
+            raise ValueError("streaming requires a vocoder engine")
+        if self._stream_overlap is None:
+            self._stream_overlap = streaming.resolve_overlap(
+                self.cfg.serve.fleet.stream_overlap, engine.vocoder[0]
+            )
+        first = True
+        for chunk in streaming.stream_wav(
+            engine, result, self.cfg.serve.fleet.stream_window,
+            self._stream_overlap,
+        ):
+            if first and arrival is not None:
+                self._ttfa_hist.observe(time.monotonic() - arrival)
+            first = False
+            yield chunk
+
+    # -- readiness / introspection ------------------------------------------
+
+    def is_ready(self) -> bool:
+        """At least one replica (or the single engine) has its full
+        lattice compiled — the /healthz readiness predicate."""
+        if self.router is not None:
+            return self.router.ready()
+        return self.engine.is_ready
+
+    def programs(self):
+        """ProgramCard dicts across every live engine (fleet: replicas
+        in index order)."""
+        if self.router is not None:
+            out = []
+            for engine in self.router.engines():
+                out.extend(engine.programs())
+            return out
+        return self.engine.programs()
 
     def _request_done(
         self, req_id: str, path: str, status: int, t0: float
@@ -369,27 +584,44 @@ class SynthesisServer:
         here now comes out of the registry (whose metrics carry their
         own locks), so there is no second bookkeeping path to drift.
         """
-        self.batcher.refresh_gauges()
+        if self.batcher is not None:
+            self.batcher.refresh_gauges()
         self.refresh_process_gauges()
         snap = self.registry.snapshot()
         counters, gauges = snap["counters"], snap["gauges"]
-        return {
+        occupancy = {}
+        for key, count in counters.items():
+            if key.startswith("serve_batch_occupancy_total{"):
+                rows = key.split('rows="', 1)[1].split('"', 1)[0]
+                occupancy[rows] = int(count)
+        out = {
+            "ready": self.is_ready(),
             "uptime_s": round(time.monotonic() - self.started, 1),
             "build": self.build,
-            "lattice_points": len(self.engine.lattice),
+            "lattice_points": (
+                len(self.engine.lattice) if self.engine is not None
+                else len(self.router.lattice)
+            ),
             "compile_count": int(counters.get("serve_compiles_total", 0)),
             "backend_compiles": int(
                 counters.get("jax_backend_compiles_total", 0)
             ),
             "dispatches": int(counters.get("serve_dispatches_total", 0)),
             "queue_depth": int(gauges.get("serve_queue_depth", 0)),
-            "batch_occupancy": {
-                str(rows): count
-                for rows, count in sorted(self.batcher.occupancy.items())
-            },
+            "batch_occupancy": dict(sorted(occupancy.items())),
             "requests": int(counters.get("serve_http_requests_total", 0)),
             "errors": int(counters.get("serve_http_errors_total", 0)),
+            # the shed/reject split: backpressure 429s vs shutdown 503s
+            # are different verdicts and must never share a counter
+            "shed": int(counters.get("serve_shed_total", 0)),
+            "rejected": int(counters.get("serve_rejected_total", 0)),
+            "active_streams": int(gauges.get("serve_active_streams", 0)),
         }
+        if self.router is not None:
+            out["replicas"] = {
+                str(i): s for i, s in sorted(self.router.states().items())
+            }
+        return out
 
     def capture_profile(self, seconds: float):
         """On-demand ``jax.profiler`` window over the live serve process
@@ -424,7 +656,34 @@ class SynthesisServer:
     def serve_forever(self):
         self.httpd.serve_forever()
 
+    def drain_streams(self, timeout: Optional[float] = None) -> bool:
+        """Block until every in-flight chunked stream finished (True) or
+        the drain timeout passed (False) — the SIGTERM contract: clients
+        mid-stream get their whole utterance before the process exits."""
+        if timeout is None:
+            timeout = self.cfg.serve.fleet.drain_timeout_s
+        deadline = time.monotonic() + timeout
+        with self._streams_cond:
+            while self._active_streams > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._streams_cond.wait(timeout=remaining)
+        return True
+
     def shutdown(self):
+        """Idempotent: stop accepting, drain in-flight streams, then
+        close the dispatch backend (which flushes admitted requests)."""
+        with self._shutdown_lock:
+            if self._shut_down:
+                return
+            self._shut_down = True
         self.httpd.shutdown()
         self.httpd.server_close()
-        self.batcher.close()
+        drained = self.drain_streams()
+        if not drained and self.events is not None:
+            self.events.emit(
+                "shutdown_drain_timeout",
+                active_streams=int(self._streams_gauge.value),
+            )
+        self.backend.close()

@@ -1,0 +1,121 @@
+"""Chunked streaming synthesis: wav windows emitted as mel frames land.
+
+The acoustic model free-runs (the whole mel for an utterance comes out
+of one AOT dispatch), so the serving latency that matters for
+time-to-first-audio is everything *after* the mel: the full-utterance
+HiFi-GAN vocode plus the whole-wav transfer. HiFi-GAN is convolutional
+— every output sample depends only on mel frames within its receptive
+field — so the wav can be produced in windows: vocode
+``[start - overlap, end + overlap)`` of the mel, trim ``overlap`` frames
+worth of samples from each side, and emit the center. With
+``overlap >= receptive_field_frames(generator)`` the seams are exact:
+the emitted samples are the same values the full-utterance vocode
+produces (the trimmed margins absorb the window's zero-padding), so
+reassembling the chunks equals the non-streaming wav bit-for-bit —
+modulo the final ``overlap`` tail, where the full vocode sees the
+acoustic model's past-end free-run frames and the stream sees silence.
+
+Windows ride the engine's precompiled vocoder lattice
+(``SynthesisEngine.vocode_window`` pads each window into the smallest
+covering ``(batch, T_mel)`` bucket), never ad-hoc shapes — a
+steady-state stream performs ZERO XLA compiles, the same invariant the
+batch path proves.
+
+``serve.fleet.stream_window`` sets the emitted frames per chunk;
+``serve.fleet.stream_overlap`` sets the per-side context (0 derives the
+smallest exact overlap from the generator's topology).
+"""
+
+import math
+from typing import Iterator, Tuple
+
+import numpy as np
+
+__all__ = [
+    "receptive_field_frames",
+    "stream_plan",
+    "stream_wav",
+    "resolve_overlap",
+]
+
+
+def receptive_field_frames(generator) -> int:
+    """Per-side receptive field of a HiFi-GAN-family generator in MEL
+    frames, from its static topology (no tracing, no params).
+
+    Walks the stack accumulating the per-side context each layer needs,
+    expressed at the mel frame rate. Conservative (each stage ceils), so
+    the returned overlap is always sufficient for exact seams:
+
+      * ``conv_pre``/``conv_post``: k=7 symmetric pads -> 3 taps/side;
+      * each transposed-conv upsample (k, u): an output sample reaches at
+        most ``ceil(k / u / 2)`` extra input positions per side;
+      * each MRF resblock at stage rate r: the dilated+plain conv chain
+        extends ``sum_d ((k-1)*d + (k-1)) / 2`` samples per side at rate
+        r; parallel kernels take the max.
+    """
+    frames = 3.0  # conv_pre: k=7, d=1 at the mel rate
+    rate = 1
+    dil_sizes = list(generator.resblock_dilation_sizes)
+    for i, (u, k) in enumerate(
+        zip(generator.upsample_rates, generator.upsample_kernel_sizes)
+    ):
+        # the transpose conv reads input at the pre-upsample rate
+        frames += math.ceil(k / u / 2) / rate
+        rate *= u
+        per_kernel = []
+        for j, rk in enumerate(generator.resblock_kernel_sizes):
+            dils = dil_sizes[j] if j < len(dil_sizes) else (1,)
+            ext = 0.0
+            for d in dils:
+                # ResBlock1 pairs each dilated conv with a plain one;
+                # ResBlock2 has only the dilated conv — charging both
+                # keeps the bound valid for either topology
+                ext += ((rk - 1) * d) / 2 + (rk - 1) / 2
+            per_kernel.append(ext)
+        frames += max(per_kernel) / rate
+    frames += 3.0 / rate  # conv_post: k=7 at the output rate
+    return int(math.ceil(frames))
+
+
+def resolve_overlap(cfg_overlap: int, generator) -> int:
+    """The per-side overlap to stream with: the configured value, or the
+    generator-derived receptive field when the config says 0 (derive)."""
+    if cfg_overlap > 0:
+        return int(cfg_overlap)
+    return receptive_field_frames(generator)
+
+
+def stream_plan(
+    mel_len: int, window: int, overlap: int
+) -> Iterator[Tuple[int, int, int, int]]:
+    """Yield ``(emit_start, emit_end, ctx_start, ctx_end)`` mel-frame
+    spans covering ``[0, mel_len)`` in ``window``-frame steps, each with
+    up to ``overlap`` frames of context clamped to the utterance."""
+    if mel_len <= 0:
+        return
+    for start in range(0, mel_len, window):
+        end = min(start + window, mel_len)
+        yield (
+            start,
+            end,
+            max(0, start - overlap),
+            min(mel_len, end + overlap),
+        )
+
+
+def stream_wav(engine, result, window: int, overlap: int) -> Iterator[np.ndarray]:
+    """Yield int16 wav chunks for one SynthesisResult's mel, in order.
+
+    Each chunk is ``vocode_window`` of the overlap-padded span with the
+    overlap margins trimmed; concatenated chunks cover exactly
+    ``mel_len * hop`` samples. The per-chunk device work is one
+    precompiled vocoder dispatch — time-to-first-audio is bounded by the
+    first window, not the utterance length.
+    """
+    gen, _ = engine.vocoder
+    hop = gen.hop_factor
+    mel = np.asarray(result.mel, np.float32)
+    for start, end, lo, hi in stream_plan(int(result.mel_len), window, overlap):
+        wav = engine.vocode_window(mel[lo:hi])
+        yield wav[(start - lo) * hop: (end - lo) * hop]

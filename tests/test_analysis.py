@@ -605,6 +605,61 @@ def test_jl010_negative_non_jitted_timing():
 
 
 # ---------------------------------------------------------------------------
+# JL011 — unbounded queues in serving code
+# ---------------------------------------------------------------------------
+
+_SERVING_PATH = "speakingstyle_tpu/serving/fake.py"
+
+
+def test_jl011_positive_unbounded_queue_in_serving():
+    assert "JL011" in _codes("""
+        import queue
+
+        class Admission:
+            def __init__(self):
+                self.pending = queue.Queue()
+    """, path=_SERVING_PATH)
+
+
+def test_jl011_positive_zero_maxsize_and_simplequeue():
+    src = """
+        import queue
+
+        def build():
+            a = queue.Queue(maxsize=0)   # stdlib: 0 = infinite
+            b = queue.SimpleQueue()      # cannot be bounded at all
+            return a, b
+    """
+    codes = sorted({
+        f.detail for f in linter.lint_source(
+            textwrap.dedent(src), _SERVING_PATH
+        ) if f.rule == "JL011"
+    })
+    assert len(codes) == 2
+
+
+def test_jl011_negative_bounded_queue():
+    assert "JL011" not in _codes("""
+        import queue
+
+        def build(depth):
+            a = queue.Queue(maxsize=depth)
+            b = queue.PriorityQueue(16)
+            return a, b
+    """, path=_SERVING_PATH)
+
+
+def test_jl011_negative_outside_serving():
+    # scoped: backpressure is a serving contract; elsewhere an unbounded
+    # queue can be a deliberate choice
+    assert "JL011" not in _codes("""
+        import queue
+
+        q = queue.Queue()
+    """, path="speakingstyle_tpu/training/fake.py")
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -715,11 +770,12 @@ def test_every_rule_is_non_vacuous():
     baselined) — rules that never fire are dead weight."""
     fired = {f.rule for f in linter.lint_paths()}
     fired |= {fp.split(":", 1)[0] for fp in linter.load_baseline()}
-    # JL009 and JL010 are deliberately absent: the tree already follows
-    # the monotonic-clock duration discipline AND syncs (reads a device
-    # value back) inside every jit-timing region, so there is nothing to
-    # baseline — the desired steady state for preventive rules; their
-    # fixtures above keep them non-vacuous.
+    # JL009, JL010, and JL011 are deliberately absent: the tree already
+    # follows the monotonic-clock duration discipline, syncs (reads a
+    # device value back) inside every jit-timing region, AND bounds every
+    # serving queue, so there is nothing to baseline — the desired steady
+    # state for preventive rules; their fixtures above keep them
+    # non-vacuous.
     for code in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
                  "JL007", "JL008"):
         assert code in fired, f"{code} never fires on the real tree"
@@ -751,10 +807,13 @@ def test_cli_check_exits_zero_on_repo():
     ("JL010", "import time\nimport jax\n\ndef bench(f, x):\n"
               "    g = jax.jit(f)\n    t0 = time.monotonic()\n"
               "    y = g(x)\n    return time.monotonic() - t0\n"),
+    ("JL011", "import queue\n\nq = queue.Queue()\n"),
 ])
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
-    # JL004 is scoped to training/ paths; JL007 to speakingstyle_tpu/
-    d = tmp_path / "speakingstyle_tpu" / "training"
+    # JL004 is scoped to training/ paths; JL007 to speakingstyle_tpu/;
+    # JL011 to speakingstyle_tpu/serving/
+    sub = "serving" if code == "JL011" else "training"
+    d = tmp_path / "speakingstyle_tpu" / sub
     d.mkdir(parents=True)
     f = d / "fixture.py"
     f.write_text(src)

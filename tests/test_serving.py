@@ -28,7 +28,11 @@ from speakingstyle_tpu.configs.config import (
     VarianceEmbeddingConfig,
     VariancePredictorConfig,
 )
-from speakingstyle_tpu.serving.batcher import ContinuousBatcher, ShutdownError
+from speakingstyle_tpu.serving.batcher import (
+    ContinuousBatcher,
+    Overloaded,
+    ShutdownError,
+)
 from speakingstyle_tpu.serving.engine import (
     CompileMonitor,
     SynthesisRequest,
@@ -272,6 +276,9 @@ def test_batcher_futures_resolve_exactly_once_under_racing_shutdown():
                 f = b.submit(_req(i))
             except ShutdownError:
                 return
+            except Overloaded:  # watermark shed under the hammer: back off
+                time.sleep(0.001)
+                continue
             with flock:
                 futures.append(f)
             i += 1
