@@ -558,6 +558,7 @@ def _tiny_serve_config():
         ModelConfig,
         ReferenceEncoderConfig,
         ServeConfig,
+        StyleConfig,
         TransformerConfig,
         VarianceEmbeddingConfig,
         VariancePredictorConfig,
@@ -589,6 +590,7 @@ def _tiny_serve_config():
             frames_per_phoneme=2,
             max_wait_ms=5.0,
             queue_depth=128,
+            style=StyleConfig(ref_buckets=[32], batch_buckets=[1, 8, 32]),
         ),
     )
 
@@ -666,16 +668,24 @@ def run_serve(duration: float = 3.0, clients=(1, 2, 4, 8, 16, 32)):
     rng = np.random.default_rng(0)
     max_src = serve.src_buckets[-1]
     max_len = min(max_src, serve.mel_buckets[-1] // serve.frames_per_phoneme)
+    # steady-state style traffic is cache hits (styles repeat; that is
+    # the StyleService's design premise) — this sweep measures the
+    # coalescing scheduler, so requests draw from a hot reference pool;
+    # the hit-rate dimension has its own sweep (run_style)
+    max_ref = engine.style.lattice.max_ref if engine.style is not None else 8
+    hot_refs = [
+        rng.standard_normal(
+            (int(rng.integers(max(8, max_ref // 2), max_ref + 1)), n_mels)
+        ).astype(np.float32)
+        for _ in range(8)
+    ]
 
     def make_request(i: int) -> SynthesisRequest:
         L = int(rng.integers(max(4, max_len // 2), max_len + 1))
-        T_ref = int(rng.integers(
-            max(8, serve.mel_buckets[-1] // 4), serve.mel_buckets[-1] + 1
-        ))
         return SynthesisRequest(
             id=f"bench{i}",
             sequence=rng.integers(1, 300, L).astype(np.int32),
-            ref_mel=rng.standard_normal((T_ref, n_mels)).astype(np.float32),
+            ref_mel=hot_refs[i % len(hot_refs)],
         )
 
     _mark(f"precompiling {len(engine.lattice)} lattice points")
@@ -771,6 +781,181 @@ def run_serve(duration: float = 3.0, clients=(1, 2, 4, 8, 16, 32)):
     return best_qps / seq_qps if seq_qps else None
 
 
+def run_style(duration: float = 3.0, hit_rates=(0.0, 0.5, 0.9, 1.0),
+              clients: int = 16):
+    """Style-path sweep: repeat-style hit-rate mix x offered load over
+    the StyleService + engine (serving/style.py).
+
+    Closed-loop clients submit through the continuous batcher; with
+    probability ``hit_rate`` a request reuses one of a small hot pool of
+    pre-encoded references (carrying cached (gamma, beta) — zero encoder
+    work), otherwise it ships a FRESH reference mel the engine must
+    resolve through the style service (cache miss -> one padded encoder
+    dispatch). Per point: QPS, the cache-hit vs cold-encode latency
+    split (two bench-side histograms classified by what the client
+    sent), the service's own hit/miss/encode counter deltas, and a
+    CompileMonitor that must read zero — the style path inherits the
+    zero-steady-state-compiles invariant.
+    """
+    import numpy as np
+
+    import jax
+
+    from speakingstyle_tpu.obs import MetricsRegistry
+    from speakingstyle_tpu.serving.batcher import ContinuousBatcher
+    from speakingstyle_tpu.serving.engine import (
+        CompileMonitor,
+        SynthesisRequest,
+    )
+
+    _mark("building style-serve engine")
+    tiny = not _is_tpu(jax.devices()[0])
+    engine, label = _serve_engine(tiny)
+    style = engine.style
+    n_mels = engine.n_mels
+    serve = engine.cfg.serve
+    max_ref = style.lattice.max_ref
+    max_len = min(serve.src_buckets[-1],
+                  serve.mel_buckets[-1] // serve.frames_per_phoneme)
+    rng = np.random.default_rng(0)
+
+    _mark(f"precompiling {len(engine.lattice)} synthesis + "
+          f"{len(style.lattice)} style points")
+    secs = engine.precompile()
+    _mark(f"precompiled {engine.compile_count}+{style.compile_count} "
+          f"programs in {secs:.1f}s")
+
+    # hot pool: the repeat styles (a voice library) — encoded once here;
+    # hot requests RE-SEND the same reference bytes, so the sweep
+    # measures the content-addressed path end to end (digest + cache
+    # hit + zero encoder work), exactly what a repeat `ref_audio` or
+    # `style_id` request costs
+    hot_mels = [
+        rng.standard_normal((max_ref, n_mels)).astype(np.float32)
+        for _ in range(8)
+    ]
+    style.encode_mels(hot_mels)
+
+    def make_request(i: int, cached: bool) -> SynthesisRequest:
+        L = int(rng.integers(max(4, max_len // 2), max_len + 1))
+        seq = rng.integers(1, 300, L).astype(np.int32)
+        if cached:
+            return SynthesisRequest(
+                id=f"style{i}", sequence=seq,
+                ref_mel=hot_mels[i % len(hot_mels)],
+            )
+        t_ref = int(rng.integers(max(8, max_ref // 2), max_ref + 1))
+        return SynthesisRequest(
+            id=f"style{i}", sequence=seq,
+            ref_mel=rng.standard_normal((t_ref, n_mels)).astype(np.float32),
+        )
+
+    # warmup: every batch bucket once, mixed cached/fresh rows
+    for b in engine.lattice.batch_buckets:
+        engine.run([make_request(10_000 + b * 100 + j, j % 2 == 0)
+                    for j in range(b)])
+
+    split_ratio = None
+    all_zero = True
+    qps_by_rate = {}
+    for hit_rate in hit_rates:
+        point = MetricsRegistry()
+        hit_hist = point.histogram(
+            "bench_style_hit_seconds",
+            help="latency of requests shipping cached style vectors",
+        )
+        cold_hist = point.histogram(
+            "bench_style_cold_seconds",
+            help="latency of requests shipping a fresh reference mel",
+        )
+        hits0 = style.registry.value("serve_style_cache_hits_total")
+        miss0 = style.registry.value("serve_style_cache_misses_total")
+        enc0 = style.dispatch_count
+        batcher = ContinuousBatcher(engine, registry=point)
+        stop_at = time.perf_counter() + duration
+        done = [0] * clients
+
+        def client(cid: int):
+            crng = np.random.default_rng(cid)
+            i = 0
+            while time.perf_counter() < stop_at:
+                cached = bool(crng.random() < hit_rate)
+                req = make_request(cid * 1_000_000 + i, cached)
+                t0 = time.monotonic()
+                try:
+                    batcher.submit(req).result(timeout=60)
+                except Exception:
+                    return
+                (hit_hist if cached else cold_hist).observe(
+                    time.monotonic() - t0
+                )
+                done[cid] += 1
+                i += 1
+
+        with CompileMonitor() as mon:
+            threads = [
+                threading.Thread(target=client, args=(c,), daemon=True)
+                for c in range(clients)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            batcher.close()
+        qps = sum(done) / dt
+        qps_by_rate[hit_rate] = qps
+        all_zero = all_zero and mon.count == 0
+
+        def pct_ms(hist, q):
+            p = hist.percentile(q)
+            return round(1e3 * p, 1) if p is not None else None
+
+        rec = {
+            "metric": "serve_style_load",
+            "hit_rate": hit_rate,
+            "clients": clients,
+            "qps": round(qps, 2),
+            "hit_p50_ms": pct_ms(hit_hist, 0.50),
+            "hit_p95_ms": pct_ms(hit_hist, 0.95),
+            "cold_p50_ms": pct_ms(cold_hist, 0.50),
+            "cold_p95_ms": pct_ms(cold_hist, 0.95),
+            "cache_hits": int(
+                style.registry.value("serve_style_cache_hits_total") - hits0
+            ),
+            "cache_misses": int(
+                style.registry.value("serve_style_cache_misses_total")
+                - miss0
+            ),
+            "encoder_dispatches": style.dispatch_count - enc0,
+            "compiles_during_serve": mon.count,
+            "model": label,
+        }
+        if rec["hit_p50_ms"] and rec["cold_p50_ms"]:
+            split_ratio = round(rec["cold_p50_ms"] / rec["hit_p50_ms"], 2)
+        print(json.dumps(rec))
+
+    base = qps_by_rate.get(hit_rates[0])
+    top = qps_by_rate.get(hit_rates[-1])
+    gain = round(top / base, 2) if base and top else None
+    print(json.dumps({
+        "metric": "serve_style_cache_qps_gain",
+        "value": gain,
+        "unit": "x (QPS all-cached / QPS all-cold, same offered load)",
+        "qps_all_cold": round(base, 2) if base else None,
+        "qps_all_cached": round(top, 2) if top else None,
+        "cold_over_hit_p50": split_ratio,
+        "cache_entries": len(style),
+        "evictions": int(
+            style.registry.value("serve_style_cache_evictions_total")
+        ),
+        "zero_compiles_after_warmup": all_zero,
+        "model": label,
+    }))
+    return gain
+
+
 def _fleet_proxy_config():
     """The fleet-sweep CPU config: the tiny model (scheduling isolated
     from compute, as in _tiny_serve_config) with TWO mel buckets so
@@ -778,7 +963,11 @@ def _fleet_proxy_config():
     utterances, and a fleet block sized for the sweep."""
     import dataclasses
 
-    from speakingstyle_tpu.configs.config import FleetConfig, ServeConfig
+    from speakingstyle_tpu.configs.config import (
+        FleetConfig,
+        ServeConfig,
+        StyleConfig,
+    )
 
     cfg = _tiny_serve_config()
     return dataclasses.replace(cfg, serve=ServeConfig(
@@ -789,6 +978,7 @@ def _fleet_proxy_config():
         max_wait_ms=5.0,
         queue_depth=128,
         fleet=FleetConfig(stream_window=8, queue_depth=256),
+        style=StyleConfig(ref_buckets=[64]),
     ))
 
 
@@ -864,6 +1054,7 @@ def run_fleet(duration: float = 3.0, replica_counts=(1, 2, 4),
         SynthesisRequest,
     )
     from speakingstyle_tpu.serving.fleet import FleetRouter
+    from speakingstyle_tpu.serving.style import StyleService
 
     on_tpu = _is_tpu(jax.devices()[0])
     if on_tpu:
@@ -890,14 +1081,22 @@ def run_fleet(duration: float = 3.0, replica_counts=(1, 2, 4),
     rng = np.random.default_rng(0)
     max_len = min(serve.src_buckets[-1],
                   serve.mel_buckets[-1] // serve.frames_per_phoneme)
+    # hot reference pool, as in run_serve: the replicas axis measures
+    # the router, not style encoding (run_style owns that dimension)
+    max_ref = serve.style.ref_buckets[-1]
+    hot_refs = [
+        rng.standard_normal(
+            (int(rng.integers(8, max_ref + 1)), n_mels)
+        ).astype(np.float32)
+        for _ in range(8)
+    ]
 
     def make_request(i: int, priority: str) -> SynthesisRequest:
         L = int(rng.integers(max(4, max_len // 2), max_len + 1))
-        T_ref = int(rng.integers(8, serve.mel_buckets[-1] + 1))
         return SynthesisRequest(
             id=f"fleet{i}",
             sequence=rng.integers(1, 300, L).astype(np.int32),
-            ref_mel=rng.standard_normal((T_ref, n_mels)).astype(np.float32),
+            ref_mel=hot_refs[i % len(hot_refs)],
             stream=True,
             priority=priority,
         )
@@ -907,19 +1106,22 @@ def run_fleet(duration: float = 3.0, replica_counts=(1, 2, 4),
     all_zero_compiles = True
     for n_replicas in replica_counts:
         registry = MetricsRegistry()
+        # one style service fleet-wide (the cli/serve.py wiring): one
+        # embedding cache, one encoder lattice, first warm-up compiles it
+        shared_style = StyleService(cfg, variables, registry=registry)
 
         def factory(reg):
             return ProxyDeviceEngine(
                 SynthesisEngine(
                     cfg, variables, vocoder=(gen, gparams), model=model,
-                    registry=reg,
+                    registry=reg, style=shared_style,
                 ),
                 device_ms,
             )
 
         _mark(f"warming {n_replicas} replicas")
         router = FleetRouter(factory, cfg, replicas=n_replicas,
-                             registry=registry)
+                             registry=registry, style=shared_style)
         if not router.wait_ready(timeout=600, n=n_replicas):
             print(json.dumps({
                 "metric": "serve_fleet_load", "replicas": n_replicas,
@@ -1084,6 +1286,17 @@ def _absorb_record(rec, metrics):
                     "full_p95_ms"):
             if isinstance(rec.get(pct), (int, float)):
                 metrics[f"fleet_{pct}_{r}r"] = (float(rec[pct]), "lower")
+    elif m == "serve_style_cache_qps_gain":
+        if isinstance(rec.get("value"), (int, float)):
+            metrics[m] = (float(rec["value"]), "higher")
+    elif m == "serve_style_load":
+        h = int(round(100 * rec.get("hit_rate", 0)))
+        if isinstance(rec.get("qps"), (int, float)):
+            metrics[f"style_qps_h{h}"] = (float(rec["qps"]), "higher")
+        for pct in ("hit_p50_ms", "cold_p50_ms", "hit_p95_ms",
+                    "cold_p95_ms"):
+            if isinstance(rec.get(pct), (int, float)):
+                metrics[f"style_{pct}_h{h}"] = (float(rec[pct]), "lower")
 
 
 def _artifact_metrics(path):
@@ -1267,10 +1480,15 @@ if __name__ == "__main__":
                if "--duration" in sys.argv else 3.0)
         run_serve(duration=dur)
         run_fleet(duration=dur)
+        run_style(duration=dur)
     elif "--fleet" in sys.argv:
         dur = (float(sys.argv[sys.argv.index("--duration") + 1])
                if "--duration" in sys.argv else 3.0)
         run_fleet(duration=dur)
+    elif "--style" in sys.argv:
+        dur = (float(sys.argv[sys.argv.index("--duration") + 1])
+               if "--duration" in sys.argv else 3.0)
+        run_style(duration=dur)
     elif "--ab" in sys.argv:
         run_ab()
     elif "--compare" in sys.argv:

@@ -33,6 +33,12 @@ JL011  unbounded queues in serving code: queue.Queue()/LifoQueue()/
        cannot be bounded) under speakingstyle_tpu/serving/ — an
        unbounded admission queue makes backpressure meaningless: load
        past capacity accumulates as latency instead of shedding
+JL012  unbounded caches in serving code: lru_cache(maxsize=None)/
+       functools.cache, or a dict literal/dict() assigned to a
+       cache-named target, under speakingstyle_tpu/serving/ — a server
+       caching per-request content (styles, mels, ...) grows without
+       bound under real traffic; use a bounded LRU with an eviction
+       counter (serving/style.py) instead
 """
 
 import ast
@@ -1401,6 +1407,133 @@ def rule_jl011(mod: ModuleInfo) -> Iterator[Finding]:
         )
 
 
+# ---------------------------------------------------------------------------
+# JL012 — unbounded caches in serving code
+# ---------------------------------------------------------------------------
+
+_LRU_CACHE_NAMES = {"functools.lru_cache", "lru_cache"}
+_ALWAYS_UNBOUNDED_CACHES = {"functools.cache", "cache"}
+
+
+def _target_names(target: ast.AST) -> Iterator[str]:
+    """Terminal identifiers of an assignment target: ``self._mel_cache``
+    -> ``_mel_cache``; tuple targets yield each element's name."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, ast.Attribute):
+        yield target.attr
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            yield from _target_names(e)
+
+
+def _lru_cache_unbounded(node: ast.Call) -> bool:
+    """``lru_cache(maxsize=None)`` / ``lru_cache(None)`` — the bare call
+    keeps the stdlib's bounded default of 128, so only an explicit None
+    is the hazard."""
+    size = node.args[0] if node.args else None
+    for kw in node.keywords:
+        if kw.arg == "maxsize":
+            size = kw.value
+    return isinstance(size, ast.Constant) and size.value is None
+
+
+def rule_jl012(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL012: unbounded caches under ``speakingstyle_tpu/serving/`` —
+    ``functools.lru_cache(maxsize=None)`` / ``functools.cache`` (which is
+    exactly that), or an empty ``{}``/``dict()`` assigned to a target
+    whose name contains "cache".
+
+    The JL011 rule for state that *content* fills rather than requests:
+    a serving process caching per-request payloads (reference styles,
+    mels, parsed uploads) in an unbounded structure converts distinct-
+    content traffic into unbounded memory — an OOM kill on a long-lived
+    replica, the slowest possible shed. Serving caches must be bounded
+    with explicit eviction (the StyleService's content-addressed LRU,
+    ``serve.style.cache_capacity`` + ``serve_style_cache_evictions_total``,
+    is the house pattern).
+    """
+    p = mod.path.replace("\\", "/")
+    if "speakingstyle_tpu/serving/" not in p:
+        return
+    # bare @functools.cache / @cache decorators (no call parentheses)
+    for fn in mod.functions:
+        for dec in fn.decorator_list:
+            if not isinstance(dec, ast.Call) and \
+                    _dotted(dec) in _ALWAYS_UNBOUNDED_CACHES:
+                yield Finding(
+                    rule="JL012",
+                    path=mod.path,
+                    line=dec.lineno,
+                    context=mod.qualname(fn),
+                    detail=f"unbounded {_dotted(dec)} (never evicts)",
+                    message=(
+                        f"`@{_dotted(dec)}` in serving code caches every "
+                        "distinct call unboundedly — use "
+                        "lru_cache(maxsize=N) or a capacity-limited LRU "
+                        "(serving/style.py)."
+                    ),
+                )
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            callee = _dotted(node.func)
+            detail = None
+            if callee in _ALWAYS_UNBOUNDED_CACHES:
+                detail = f"{callee} (never evicts)"
+            elif callee in _LRU_CACHE_NAMES and _lru_cache_unbounded(node):
+                detail = f"{callee}(maxsize=None)"
+            if detail is None:
+                continue
+            fn = mod.enclosing_function(node)
+            yield Finding(
+                rule="JL012",
+                path=mod.path,
+                line=node.lineno,
+                context=mod.qualname(fn or mod.tree),
+                detail=f"unbounded {detail}",
+                message=(
+                    f"unbounded cache `{detail}` in serving code: per-"
+                    "request content accumulates without eviction — bound "
+                    "the cache (lru_cache(maxsize=N), or a capacity-"
+                    "limited LRU like serving/style.py's) so memory is a "
+                    "function of capacity, not traffic history."
+                ),
+            )
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = node.value
+            is_empty_dict = isinstance(value, ast.Dict) and not value.keys
+            is_dict_call = (
+                isinstance(value, ast.Call)
+                and _dotted(value.func) == "dict" and not value.args
+                and not value.keywords
+            )
+            if not (is_empty_dict or is_dict_call):
+                continue
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            for t in targets:
+                for name in _target_names(t):
+                    if "cache" not in name.lower():
+                        continue
+                    fn = mod.enclosing_function(node)
+                    yield Finding(
+                        rule="JL012",
+                        path=mod.path,
+                        line=node.lineno,
+                        context=mod.qualname(fn or mod.tree),
+                        detail=f"dict cache {name!r} with no bound",
+                        message=(
+                            f"`{name}` is a plain dict used as a cache in "
+                            "serving code: nothing ever evicts, so memory "
+                            "grows with distinct request content. Use a "
+                            "bounded LRU (OrderedDict + capacity + "
+                            "eviction counter — see serving/style.py)."
+                        ),
+                    )
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -1413,4 +1546,5 @@ RULES = {
     "JL009": rule_jl009,
     "JL010": rule_jl010,
     "JL011": rule_jl011,
+    "JL012": rule_jl012,
 }

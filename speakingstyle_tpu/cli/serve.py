@@ -5,9 +5,14 @@ checkpoint named by ``--restore_step``, precompiles the full shape-bucket
 lattice (``serve.*`` config block), then serves:
 
   POST /synthesize  {"text": ..., "speaker_id"?, "pitch_control"?,
-                     "energy_control"?, "duration_control"?, "ref_audio"?,
+                     "energy_control"?, "duration_control"?, "style_id"?,
+                     "ref_audio"? (serve.style.ref_dir-confined path),
                      "priority"? (SLO class)}
                     -> audio/wav (429 + Retry-After under backpressure)
+  POST /styles      upload a reference wav -> {"style_id": sha256, ...};
+                    content-addressed and cached, so a repeat style skips
+                    the reference encoder entirely (serving/style.py)
+  GET  /styles      -> resident embedding-cache entries
   POST /synthesize/stream -> chunked audio/wav: overlap-trimmed windows
                        emitted as they are vocoded (serving/streaming.py)
                        — time-to-first-audio is the first-window bound
@@ -67,6 +72,12 @@ def build_parser(parser=None):
         "--replicas", type=int, default=None,
         help="override serve.fleet.replicas: >1 serves through the fleet "
              "router (per-replica engines, EDF dispatch, load shedding)",
+    )
+    parser.add_argument(
+        "--ref_dir", type=str, default=None,
+        help="override serve.style.ref_dir: the allowlist directory for "
+             'request "ref_audio" paths (unset = uploads via POST /styles '
+             "only)",
     )
     return parser
 
@@ -129,6 +140,14 @@ def main(args):
     )
 
     cfg = config_from_args(args)
+    if getattr(args, "ref_dir", None):
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, serve=dataclasses.replace(
+            cfg.serve, style=dataclasses.replace(
+                cfg.serve.style, ref_dir=args.ref_dir
+            )
+        ))
     if cfg.train.obs.compilation_cache_dir:
         # before the lattice precompile: a warm restart then serves its
         # AOT programs out of the persistent cache instead of XLA
@@ -159,21 +178,31 @@ def main(args):
         from speakingstyle_tpu.obs import MetricsRegistry
         from speakingstyle_tpu.serving.engine import SynthesisEngine
         from speakingstyle_tpu.serving.fleet import FleetRouter
+        from speakingstyle_tpu.serving.style import StyleService
 
         variables, vocoder, lattice, model = load_engine_parts(
             cfg, args.restore_step,
             vocoder_ckpt=args.vocoder_ckpt, griffin_lim=args.griffin_lim,
         )
 
-        def factory(registry: "MetricsRegistry") -> "SynthesisEngine":
+        registry = MetricsRegistry()
+        # ONE style service across all replicas: one embedding cache,
+        # one AOT encoder lattice (the first replica's warm-up compiles
+        # it; the rest find it ready)
+        style = (
+            StyleService(cfg, variables, registry=registry)
+            if cfg.model.use_reference_encoder else None
+        )
+
+        def factory(reg: "MetricsRegistry") -> "SynthesisEngine":
             return SynthesisEngine(
                 cfg, variables, vocoder=vocoder, lattice=lattice,
-                model=model, registry=registry,
+                model=model, registry=reg, style=style,
             )
 
         router = FleetRouter(
             factory, cfg, replicas=replicas,
-            registry=MetricsRegistry(), events=events,
+            registry=registry, events=events, style=style,
         )
         print(
             f"warming {replicas} replicas x {len(router.lattice)} lattice "
@@ -192,12 +221,18 @@ def main(args):
             cfg, args.restore_step,
             vocoder_ckpt=args.vocoder_ckpt, griffin_lim=args.griffin_lim,
         )
-        print(f"precompiling {len(engine.lattice)} lattice points ...",
-              flush=True)
-        secs = engine.precompile()
+        has_style = engine.style is not None
+        style_points = len(engine.style.lattice) if has_style else 0
         print(
-            f"precompiled {engine.compile_count} programs in {secs:.1f}s; "
-            "steady-state serving performs zero compiles", flush=True,
+            f"precompiling {len(engine.lattice)} lattice points "
+            f"+ {style_points} style-encoder points ...", flush=True,
+        )
+        secs = engine.precompile()
+        style_n = engine.style.compile_count if has_style else 0
+        print(
+            f"precompiled {engine.compile_count} synthesis + {style_n} "
+            f"style programs in {secs:.1f}s; steady-state serving "
+            "performs zero compiles", flush=True,
         )
         server = SynthesisServer(
             engine,
@@ -218,8 +253,8 @@ def main(args):
 
     host, port = server.address[:2]
     print(f"serving on http://{host}:{port} "
-          "(POST /synthesize, POST /synthesize/stream, GET /healthz, "
-          "GET /metrics, GET /debug/programs, "
+          "(POST /synthesize, POST /synthesize/stream, POST /styles, "
+          "GET /styles, GET /healthz, GET /metrics, GET /debug/programs, "
           "POST /debug/profile?seconds=N)", flush=True)
     try:
         server.serve_forever()

@@ -10,7 +10,11 @@ Both modes run through the serving engine's shape-bucket lattice and
 continuous batcher (serving/): every dispatch is padded to a lattice
 point, so the CLI one-shot path and the HTTP server execute the identical
 compiled programs — there is exactly one padded-dispatch code path in the
-tree.
+tree. Reference audio routes through the StyleService's content-addressed
+embedding cache (serving/style.py): a repeated reference — the same
+``--ref_audio`` across a whole batch, or per-item dataset mels with
+duplicate content — encodes ONCE and every other request reuses the
+cached FiLM (gamma, beta) vectors.
 """
 
 import argparse
@@ -39,7 +43,10 @@ def build_parser(parser=None):
     )
     parser.add_argument(
         "--ref_audio", type=str, default=None,
-        help="reference wav for the speaking style, single mode only (required)",
+        help="reference wav for the speaking style: required in single "
+             "mode; in batch mode it overrides the per-item dataset mels "
+             "(encoded ONCE through the StyleService cache for the whole "
+             "batch)",
     )
     parser.add_argument(
         "--speaker_id", type=str, default="0",
@@ -70,6 +77,16 @@ def _parse_control(spec: str):
     """"1.0" -> scalar; "1.0,2.5,0.9" -> per-word list."""
     parts = [float(x) for x in spec.split(",")]
     return parts[0] if len(parts) == 1 else parts
+
+
+def _cli_style(engine, cfg, ref_audio):
+    """Resolve a --ref_audio wav to cached StyleVectors: content-addressed
+    by the file bytes, so repeats (across a batch OR across invocations
+    inside one process) hit the embedding cache instead of the encoder."""
+    if engine.style is None or ref_audio is None:
+        return None
+    with open(ref_audio, "rb") as f:
+        return engine.style.encode_wav_bytes(f.read())
 
 
 def _control_value(spec, spans):
@@ -153,7 +170,11 @@ def main(args):
         requests.append(SynthesisRequest(
             id=safe_id or "utt",
             sequence=np.asarray(sequence, np.int32),
-            ref_mel=load_ref_mel(cfg, args.ref_audio),
+            style=_cli_style(engine, cfg, args.ref_audio),
+            ref_mel=(
+                load_ref_mel(cfg, args.ref_audio)
+                if engine.style is None else None
+            ),
             speaker=speaker,
             raw_text=args.text,
             p_control=_control_value(p_c, spans),
@@ -163,10 +184,17 @@ def main(args):
     else:
         if not np.isscalar(p_c) or not np.isscalar(e_c) or not np.isscalar(d_c):
             raise SystemExit("per-word controls need single mode with English text")
+        # an explicit --ref_audio styles the WHOLE batch: one encoder
+        # pass through the StyleService cache, every request reuses the
+        # cached (gamma, beta) — N utterances, one encode
+        shared_style = (
+            _cli_style(engine, cfg, args.ref_audio)
+            if args.ref_audio is not None else None
+        )
         ds = TextBatcher(args.source, cfg)
         for i in range(len(ds)):
             item = ds[i]
-            if item["mel"] is None:
+            if shared_style is None and item["mel"] is None:
                 raise SystemExit(
                     f"no reference mel for {item['id']!r}: the style encoder "
                     "requires one (reference: synthesize.py --ref_audio)"
@@ -174,7 +202,8 @@ def main(args):
             requests.append(SynthesisRequest(
                 id=item["id"],
                 sequence=item["text"],
-                ref_mel=item["mel"],
+                style=shared_style,
+                ref_mel=None if shared_style is not None else item["mel"],
                 speaker=item["speaker"],
                 raw_text=item["raw_text"],
                 p_control=float(p_c), e_control=float(e_c),

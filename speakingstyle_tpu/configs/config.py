@@ -526,6 +526,53 @@ class FleetConfig:
 
 
 @dataclass(frozen=True)
+class StyleConfig:
+    """Style-service knobs (serving/style.py — ARCHITECTURE.md "Style
+    service").
+
+    The reference encoder runs as its own AOT-precompiled subsystem over
+    a ``(batch, ref_len)`` bucket lattice, fronted by a content-addressed
+    LRU cache (sha256 of the reference bytes -> FiLM ``(gamma, beta)``
+    vectors) so repeat styles never touch the encoder. Decoupling the
+    reference length from the synthesis lattice's ``T_mel`` axis is the
+    point: a long reference no longer inflates the output bucket.
+    """
+
+    # padded reference-mel lengths the style encoder compiles for (the
+    # top bucket caps the longest admissible reference)
+    ref_buckets: List[int] = field(default_factory=lambda: [256, 512, 1000])
+    # encode batch sizes; empty = inherit serve.batch_buckets
+    batch_buckets: List[int] = field(default_factory=list)
+    # content-addressed LRU entries retained (gamma+beta vectors are a
+    # few KB each; bounded by jaxlint JL012's no-unbounded-caches rule)
+    cache_capacity: int = 512
+    # allowlist directory for server-side "ref_audio" request paths; ""
+    # (the default) refuses path-based references entirely — uploads go
+    # through POST /styles instead
+    ref_dir: str = ""
+
+    def __post_init__(self):
+        for name in ("ref_buckets", "batch_buckets"):
+            vals = getattr(self, name)
+            if name == "ref_buckets" and not vals:
+                raise ValueError("serve.style.ref_buckets must be non-empty")
+            if any(v <= 0 for v in vals):
+                raise ValueError(
+                    f"serve.style.{name} must be positive, got {vals}"
+                )
+            if sorted(vals) != list(vals) or len(set(vals)) != len(vals):
+                raise ValueError(
+                    f"serve.style.{name} must be strictly ascending, "
+                    f"got {vals}"
+                )
+        if self.cache_capacity <= 0:
+            raise ValueError(
+                f"serve.style.cache_capacity must be > 0, "
+                f"got {self.cache_capacity}"
+            )
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Continuous-batching synthesis server knobs (serving/engine.py,
     serving/batcher.py).
@@ -533,8 +580,9 @@ class ServeConfig:
     The three bucket lists span the AOT-precompiled shape lattice: every
     served dispatch runs at some ``(batch, L_src, T_mel)`` drawn from
     their cross product, compiled once at server start. ``T_mel`` bounds
-    BOTH the style-reference mel input and the free-run output buffer
-    (``max_mel_len``), so one lattice axis covers both mel shapes.
+    the free-run output buffer (``max_mel_len``); the style-reference
+    mel rides its own ``serve.style.ref_buckets`` axis (serving/style.py)
+    so reference length never inflates the output bucket.
     """
 
     # batch sizes the engine compiles for; a dispatch of n requests runs
@@ -571,6 +619,8 @@ class ServeConfig:
     log_events: bool = False
     # fleet serving: multi-replica router, SLO admission, streaming
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    # style service: AOT reference-encoder lattice + embedding cache
+    style: StyleConfig = field(default_factory=StyleConfig)
 
     def __post_init__(self):
         for name in ("batch_buckets", "src_buckets", "mel_buckets"):

@@ -48,6 +48,8 @@ class FastSpeech2(nn.Module):
         p_control: float = 1.0,
         e_control: float = 1.0,
         d_control: float = 1.0,
+        gammas=None,       # [B, 1, d] precomputed FiLM scale (serve path)
+        betas=None,        # [B, 1, d] precomputed FiLM shift
         deterministic: bool = True,
     ):
         cfg = self.config.model
@@ -70,7 +72,8 @@ class FastSpeech2(nn.Module):
         contracts.assert_shape(mel_lens, (B,), "FastSpeech2.mel_lens")
         src_pad_mask = length_to_mask(src_lens, L_src)
         mel_pad_mask = (
-            length_to_mask(mel_lens, mels.shape[1]) if mel_lens is not None else None
+            length_to_mask(mel_lens, mels.shape[1])
+            if mel_lens is not None and mels is not None else None
         )
 
         from speakingstyle_tpu.models.factory import (
@@ -78,11 +81,24 @@ class FastSpeech2(nn.Module):
             reference_encoder_from_config,
         )
 
-        gammas = betas = None
-        if cfg.use_reference_encoder:
+        # Two ways into FiLM conditioning: the fused path runs the
+        # reference encoder over a reference mel (training, and any
+        # caller that still ships ``mels``); the split serve path passes
+        # precomputed (gamma, beta) — the StyleService (serving/style.py)
+        # ran the encoder AOT, possibly long ago, possibly cached — and
+        # the synthesis program then contains no encoder at all.
+        if cfg.use_reference_encoder and gammas is None:
+            if mels is None:
+                raise ValueError(
+                    "use_reference_encoder needs a reference: pass `mels` "
+                    "(fused path) or precomputed `gammas`/`betas` (style "
+                    "service path)"
+                )
             gammas, betas = reference_encoder_from_config(
                 self.config, n_position=n_position, name="reference_encoder"
             )(mels, mel_pad_mask, deterministic=deterministic)
+        elif not cfg.use_reference_encoder:
+            gammas = betas = None
 
         x = fft_stack_from_config(
             self.config,

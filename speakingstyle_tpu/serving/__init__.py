@@ -3,14 +3,19 @@ batching + fleet routing (see ARCHITECTURE.md "Serving" and "Fleet
 serving & streaming").
 
 Layering:
-  lattice.py   — the (batch, L_src, T_mel) bucket grid + covering lookup
+  lattice.py   — the (batch, L_src, T_mel) bucket grid + covering lookup,
+                 plus the style encoder's (batch, ref_len) StyleLattice
+  style.py     — AOT reference-encoder subsystem: content-addressed
+                 (gamma, beta) embedding cache over its own ref-length
+                 bucket axis (POST /styles backs onto it)
   engine.py    — AOT precompile (donated buffers) + padded dispatch
   batcher.py   — admission queue, deadline coalescing, per-request futures
   streaming.py — overlap-trimmed wav windows over the vocoder lattice
   fleet.py     — N replicas behind an SLO-aware EDF router with
                  watermark load-shedding and elastic warm-up
   server.py    — stdlib HTTP front-end (POST /synthesize,
-                 POST /synthesize/stream, GET /healthz, GET /metrics)
+                 POST /synthesize/stream, POST/GET /styles,
+                 GET /healthz, GET /metrics)
 """
 
 from speakingstyle_tpu.serving.batcher import (  # noqa: F401
@@ -28,4 +33,9 @@ from speakingstyle_tpu.serving.lattice import (  # noqa: F401
     Bucket,
     BucketLattice,
     RequestTooLarge,
+    StyleLattice,
+)
+from speakingstyle_tpu.serving.style import (  # noqa: F401
+    StyleService,
+    StyleVectors,
 )

@@ -8,6 +8,17 @@ request buffers donated. Steady-state dispatch then only ever calls the
 stored ``Compiled`` executables — which hard-error on a shape mismatch
 rather than retrace — so the serve loop structurally cannot compile.
 
+The acoustic programs consume precomputed FiLM ``(gamma, beta)`` vectors
+rather than a raw reference mel: the reference encoder lives in the
+engine's ``StyleService`` (serving/style.py) with its own AOT
+``(batch, ref_len)`` lattice and a content-addressed embedding cache.
+Requests either carry ``style`` (pre-resolved vectors — the HTTP and CLI
+paths) or a raw ``ref_mel`` the engine resolves through the service at
+dispatch (cache-first, so repeat styles cost zero encoder work). The
+split also drops the reference length from ``required_mel``: ``T_mel``
+now sizes only the free-run output buffer, so a long reference no longer
+forces a larger synthesis bucket.
+
 Two compile counters back that claim up, both living in the engine's
 metrics registry (``speakingstyle_tpu/obs``):
 
@@ -52,6 +63,7 @@ from speakingstyle_tpu.obs.cost import (
     publish_program_gauges,
 )
 from speakingstyle_tpu.serving.lattice import Bucket, BucketLattice, RequestTooLarge
+from speakingstyle_tpu.serving.style import StyleService, StyleVectors
 from speakingstyle_tpu.training.resilience import retry_io
 
 __all__ = [
@@ -72,11 +84,17 @@ Control = Union[float, np.ndarray]  # scalar, or per-phoneme [src_len] array
 
 @dataclass
 class SynthesisRequest:
-    """One admitted utterance, fully host-side preprocessed (G2P done)."""
+    """One admitted utterance, fully host-side preprocessed (G2P done).
+
+    Style comes in one of two forms: ``style`` (precomputed FiLM vectors
+    — a cache hit or a ``POST /styles`` upload, the fast path) or a raw
+    ``ref_mel`` the engine resolves through its StyleService at dispatch
+    (content-addressed, so repeats still skip the encoder)."""
 
     id: str
     sequence: np.ndarray          # [src_len] int32 phoneme ids
-    ref_mel: np.ndarray           # [ref_len, n_mels] float32 style reference
+    ref_mel: Optional[np.ndarray] = None  # [ref_len, n_mels] f32 reference
+    style: Optional[StyleVectors] = None  # precomputed (gamma, beta)
     speaker: int = 0
     raw_text: str = ""
     p_control: Control = 1.0
@@ -148,6 +166,7 @@ class SynthesisEngine:
         lattice: Optional[BucketLattice] = None,
         model=None,
         registry: Optional[MetricsRegistry] = None,
+        style: Optional[StyleService] = None,
     ):
         from speakingstyle_tpu.models.factory import build_model
 
@@ -176,6 +195,18 @@ class SynthesisEngine:
         # bridge feeds jax_backend_compiles_total into it
         self.registry = registry if registry is not None else MetricsRegistry()
         watch_compiles(self.registry)
+        # the style subsystem: pass one to share (the fleet router does —
+        # one embedding cache + one encoder lattice across all replicas);
+        # absent, the engine owns a private service over the same
+        # registry. A model without the reference encoder needs none.
+        self._use_style = cfg.model.use_reference_encoder
+        self._film_dim = cfg.model.reference_encoder.encoder_hidden
+        if style is not None:
+            self.style = style
+        elif self._use_style:
+            self.style = StyleService(cfg, variables, registry=self.registry)
+        else:
+            self.style = None
         self._compiles = self.registry.counter(
             "serve_compiles_total",
             help="XLA programs compiled by the engine (precompile + misses)",
@@ -235,19 +266,25 @@ class SynthesisEngine:
     # -- compilation --------------------------------------------------------
 
     def _acoustic_fn(self, t_mel: int):
-        def fn(variables, speakers, texts, src_lens, mels, mel_lens,
+        def fn(variables, speakers, texts, src_lens, gammas, betas,
                p_control, e_control, d_control):
+            # no reference mel and no encoder in this program: FiLM
+            # conditioning arrives precomputed (StyleService). A model
+            # without the reference encoder ignores gammas/betas (XLA
+            # dead-code-eliminates the unused inputs).
             out = self.model.apply(
                 variables,
                 speakers=speakers,
                 texts=texts,
                 src_lens=src_lens,
-                mels=mels,
-                mel_lens=mel_lens,
+                mels=None,
+                mel_lens=None,
                 max_mel_len=t_mel,
                 p_control=p_control,
                 e_control=e_control,
                 d_control=d_control,
+                gammas=gammas if self._use_style else None,
+                betas=betas if self._use_style else None,
                 deterministic=True,
             )
             keep = ("mel_postnet", "mel_lens", "durations",
@@ -271,6 +308,11 @@ class SynthesisEngine:
         for b in self.lattice.batch_buckets:
             for t in self.lattice.mel_buckets:
                 self._compile_vocoder(b, t)
+        if self.style is not None:
+            # idempotent: a fleet's replicas share one service, so only
+            # the first precompile pays (counted in its own
+            # serve_style_compiles_total, not the engine's counter)
+            self.style.precompile()
         return time.monotonic() - t0
 
     def _compile_acoustic(self, bucket: Bucket):
@@ -279,13 +321,14 @@ class SynthesisEngine:
 
         b, l, t = bucket.b, bucket.l_src, bucket.t_mel
         s = jax.ShapeDtypeStruct
+        d = self._film_dim
         args = (
             self.variables,
             s((b,), jnp.int32),                        # speakers
             s((b, l), jnp.int32),                      # texts
             s((b,), jnp.int32),                        # src_lens
-            s((b, t, self.n_mels), jnp.float32),       # ref mels
-            s((b,), jnp.int32),                        # mel_lens
+            s((b, 1, d), jnp.float32),                 # gammas (FiLM scale)
+            s((b, 1, d), jnp.float32),                 # betas (FiLM shift)
             s((b, self._ctl_len(self._pitch_axis, bucket)), jnp.float32),
             s((b, self._ctl_len(self._energy_axis, bucket)), jnp.float32),
             s((b, l), jnp.float32),                    # d_control
@@ -372,11 +415,13 @@ class SynthesisEngine:
     # -- admission geometry -------------------------------------------------
 
     def required_mel(self, req: SynthesisRequest) -> int:
-        """The T_mel a request needs: covers its style-reference input and
-        a ``frames_per_phoneme``-bounded free-run output buffer (longer
-        predictions truncate, matching the reference's max_seq_len clamp)."""
-        est_out = len(req.sequence) * self.cfg.serve.frames_per_phoneme
-        return max(req.ref_mel.shape[0], est_out)
+        """The T_mel a request needs: a ``frames_per_phoneme``-bounded
+        free-run output buffer (longer predictions truncate, matching
+        the reference's max_seq_len clamp). Deliberately independent of
+        the reference length — references ride the StyleService's own
+        ``(batch, ref_len)`` lattice, so a max-length reference no
+        longer forces a larger synthesis bucket."""
+        return len(req.sequence) * self.cfg.serve.frames_per_phoneme
 
     def cover(self, requests: List[SynthesisRequest]) -> Bucket:
         return self.lattice.cover(
@@ -387,12 +432,25 @@ class SynthesisEngine:
 
     def admit(self, req: SynthesisRequest) -> None:
         """Raise RequestTooLarge now (at submit) rather than at dispatch,
-        where it would poison the whole coalesced batch."""
-        if req.sequence.ndim != 1 or req.ref_mel.ndim != 2:
+        where it would poison the whole coalesced batch. The reference is
+        validated against the style lattice's own ref-length axis."""
+        if req.sequence.ndim != 1:
             raise ValueError(
-                f"request {req.id!r}: sequence must be [L] and ref_mel "
-                f"[T, n_mels], got {req.sequence.shape} / {req.ref_mel.shape}"
+                f"request {req.id!r}: sequence must be [L], "
+                f"got {req.sequence.shape}"
             )
+        if self._use_style and req.style is None:
+            if req.ref_mel is None:
+                raise ValueError(
+                    f"request {req.id!r}: pass precomputed style vectors "
+                    "or a [T, n_mels] ref_mel"
+                )
+            if req.ref_mel.ndim != 2:
+                raise ValueError(
+                    f"request {req.id!r}: ref_mel must be [T, n_mels], "
+                    f"got {req.ref_mel.shape}"
+                )
+            self.style.lattice.cover(1, req.ref_mel.shape[0])
         self.lattice.cover(1, len(req.sequence), self.required_mel(req))
 
     # -- dispatch -----------------------------------------------------------
@@ -416,6 +474,31 @@ class SynthesisEngine:
             describe="serve device transfer",
         )
 
+    def _resolve_styles(
+        self, requests: List[SynthesisRequest]
+    ) -> List[Optional[StyleVectors]]:
+        """Per-request FiLM vectors: precomputed ones pass through;
+        raw ``ref_mel``s resolve through the StyleService cache-first
+        (one batched encoder dispatch covers all fresh references —
+        duplicates and repeats cost zero encoder work)."""
+        if not self._use_style:
+            return [None] * len(requests)
+        styles: List[Optional[StyleVectors]] = [r.style for r in requests]
+        mels, idxs = [], []
+        for i, r in enumerate(requests):
+            if styles[i] is None:
+                if r.ref_mel is None:
+                    raise ValueError(
+                        f"request {r.id!r} carries neither style vectors "
+                        "nor a ref_mel"
+                    )
+                mels.append(r.ref_mel)
+                idxs.append(i)
+        if mels:
+            for i, sv in zip(idxs, self.style.encode_mels(mels)):
+                styles[i] = sv
+        return styles
+
     def run(self, requests: List[SynthesisRequest]) -> List[SynthesisResult]:
         """Pad ``requests`` into their smallest covering bucket, execute
         the precompiled programs, and scatter per-request results.
@@ -426,6 +509,7 @@ class SynthesisEngine:
         """
         if not requests:
             return []
+        styles = self._resolve_styles(requests)
         bucket = self.cover(requests)
         with self._lock:
             if bucket not in self._acoustic:
@@ -441,21 +525,21 @@ class SynthesisEngine:
         speakers = np.zeros((b,), np.int32)
         texts = np.zeros((b, l), np.int32)
         src_lens = np.zeros((b,), np.int32)
-        mels = np.zeros((b, t, self.n_mels), np.float32)
-        mel_lens = np.zeros((b,), np.int32)
+        gammas = np.zeros((b, 1, self._film_dim), np.float32)
+        betas = np.zeros((b, 1, self._film_dim), np.float32)
         for i, r in enumerate(requests):
             speakers[i] = r.speaker
             texts[i, : len(r.sequence)] = r.sequence
             src_lens[i] = len(r.sequence)
-            ref = r.ref_mel[:t]
-            mels[i, : ref.shape[0]] = ref
-            mel_lens[i] = ref.shape[0]
+            if styles[i] is not None:
+                gammas[i, 0] = styles[i].gamma
+                betas[i, 0] = styles[i].beta
         arrays = {
             "speakers": speakers,
             "texts": texts,
             "src_lens": src_lens,
-            "mels": mels,
-            "mel_lens": mel_lens,
+            "gammas": gammas,
+            "betas": betas,
             "p_control": _fill_control(
                 [r.p_control for r in requests], b,
                 self._ctl_len(self._pitch_axis, bucket)),
@@ -468,7 +552,7 @@ class SynthesisEngine:
         dev = self._transfer(arrays)
         out = self._acoustic[bucket](
             self.variables, dev["speakers"], dev["texts"], dev["src_lens"],
-            dev["mels"], dev["mel_lens"], dev["p_control"], dev["e_control"],
+            dev["gammas"], dev["betas"], dev["p_control"], dev["e_control"],
             dev["d_control"],
         )
         mel_out = out["mel_postnet"]  # [b, t, n_mels] device array

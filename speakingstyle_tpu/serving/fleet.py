@@ -53,7 +53,7 @@ from speakingstyle_tpu.serving.engine import (
     SynthesisResult,
     bucket_label,
 )
-from speakingstyle_tpu.serving.lattice import BucketLattice
+from speakingstyle_tpu.serving.lattice import BucketLattice, StyleLattice
 
 # replica lifecycle states (serve_replica_state gauge values in parens)
 COLD = "cold"          # (0) constructed, nothing compiled
@@ -104,6 +104,10 @@ class FleetRouter:
         replicas: Optional[int] = None,
         registry: Optional[MetricsRegistry] = None,
         events: Optional[JsonlEventLog] = None,
+        style=None,  # StyleService shared by every replica (cli/serve.py
+        # builds one and closes the factory over it): one embedding
+        # cache, one encoder lattice — a style uploaded once is warm
+        # fleet-wide. None = replicas own private services (tests).
     ):
         serve = cfg.serve
         fleet = serve.fleet
@@ -112,7 +116,11 @@ class FleetRouter:
         self.engine_factory = engine_factory
         self.registry = registry if registry is not None else MetricsRegistry()
         self.events = events
+        self.style = style
         self.lattice = BucketLattice.from_config(serve)
+        # admission geometry for raw-reference requests (engine-free,
+        # like self.lattice: admission must work while replicas warm)
+        self.style_lattice = StyleLattice.from_config(serve)
         self.max_batch = self.lattice.max_batch
         self.max_wait = serve.max_wait_ms / 1e3
         self._frames_per_phoneme = serve.frames_per_phoneme
@@ -275,15 +283,21 @@ class FleetRouter:
                 f"unknown priority class {klass!r}; configured classes: "
                 f"{sorted(self.fleet.class_deadline_ms)}"
             )
-        if req.sequence.ndim != 1 or req.ref_mel.ndim != 2:
+        if req.sequence.ndim != 1:
             raise ValueError(
-                f"request {req.id!r}: sequence must be [L] and ref_mel "
-                f"[T, n_mels], got {req.sequence.shape} / {req.ref_mel.shape}"
+                f"request {req.id!r}: sequence must be [L], "
+                f"got {req.sequence.shape}"
             )
-        need_mel = max(
-            req.ref_mel.shape[0],
-            len(req.sequence) * self._frames_per_phoneme,
-        )
+        if req.style is None and req.ref_mel is not None:
+            if req.ref_mel.ndim != 2:
+                raise ValueError(
+                    f"request {req.id!r}: ref_mel must be [T, n_mels], "
+                    f"got {req.ref_mel.shape}"
+                )
+            # reference length rides the style lattice, NOT T_mel — a
+            # max-length reference no longer inflates the output bucket
+            self.style_lattice.cover(1, req.ref_mel.shape[0])
+        need_mel = len(req.sequence) * self._frames_per_phoneme
         self.lattice.cover(1, len(req.sequence), need_mel)
         return klass
 

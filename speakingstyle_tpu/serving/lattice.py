@@ -28,8 +28,8 @@ class Bucket:
 
     b: int       # batch rows
     l_src: int   # padded phoneme-sequence length
-    t_mel: int   # padded mel length: reference-mel input AND free-run
-                 # output buffer (max_mel_len)
+    t_mel: int   # padded mel length: the free-run output buffer
+                 # (max_mel_len); reference mels ride the StyleLattice
 
     @property
     def volume(self) -> int:
@@ -116,4 +116,65 @@ class BucketLattice:
         return (
             _cover_axis(self.batch_buckets, 1, "batch"),
             _cover_axis(self.mel_buckets, t_mel, "mel"),
+        )
+
+
+class StyleLattice:
+    """The style encoder's ``(batch, ref_len)`` bucket grid.
+
+    The second input axis the reference encoder needed all along
+    (ROADMAP item 3): reference mels are padded into these points,
+    compiled AOT by the StyleService (serving/style.py), so the
+    synthesis lattice's ``T_mel`` axis covers only the free-run output
+    buffer. Same covering discipline as BucketLattice — a full cross
+    product, so the elementwise-smallest cover exists and is unique.
+    """
+
+    def __init__(
+        self, batch_buckets: Sequence[int], ref_buckets: Sequence[int]
+    ):
+        for name, vals in (("batch", batch_buckets), ("ref", ref_buckets)):
+            if not vals or sorted(vals) != list(vals) or min(vals) <= 0:
+                raise ValueError(
+                    f"style {name} buckets must be non-empty ascending "
+                    f"positive, got {list(vals)}"
+                )
+        self.batch_buckets = list(batch_buckets)
+        self.ref_buckets = list(ref_buckets)
+
+    @classmethod
+    def from_config(cls, serve: ServeConfig) -> "StyleLattice":
+        """``serve.style.batch_buckets`` empty means inherit the serve
+        batch buckets: a coalesced dispatch's fresh references then
+        always batch-encode in one encoder dispatch."""
+        return cls(
+            serve.style.batch_buckets or serve.batch_buckets,
+            serve.style.ref_buckets,
+        )
+
+    @property
+    def max_batch(self) -> int:
+        return self.batch_buckets[-1]
+
+    @property
+    def max_ref(self) -> int:
+        return self.ref_buckets[-1]
+
+    def points(self) -> List[Tuple[int, int]]:
+        """All ``(batch, ref_len)`` points, smallest volume first."""
+        pts = [
+            (b, r) for b in self.batch_buckets for r in self.ref_buckets
+        ]
+        return sorted(pts, key=lambda p: (p[0] * p[1], p))
+
+    def __len__(self) -> int:
+        return len(self.batch_buckets) * len(self.ref_buckets)
+
+    def cover(self, n: int, ref_len: int) -> Tuple[int, int]:
+        """The unique elementwise-smallest point covering ``n``
+        references of length <= ``ref_len``; RequestTooLarge when an
+        axis cannot cover (error text names ``serve.style.*_buckets``)."""
+        return (
+            _cover_axis(self.batch_buckets, n, "style.batch"),
+            _cover_axis(self.ref_buckets, ref_len, "style.ref"),
         )

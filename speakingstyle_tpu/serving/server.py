@@ -11,9 +11,18 @@ AOT-precompiled executables. The one jax touch in a handler is the
 /debug/profile capture hook, which only starts/stops the profiler.
 
 API (request schema — every field but "text" optional):
-  POST /synthesize     {"text": ..., "speaker_id"?, "pitch_control"?,
-                        "energy_control"?, "duration_control"?,
-                        "ref_audio"? (server-side wav path),
+  POST /synthesize     {"text": ..., "speaker_id"?/"speaker"? (numeric id
+                        or speakers.json name — unknown names and
+                        out-of-registry ids -> 400), "pitch_control"?,
+                        "energy_control"?, "duration_control"? (a scalar,
+                        or a per-WORD list like [1.0, 2.5, 1.0] — English
+                        text only; expanded to per-phoneme arrays via the
+                        span-preserving G2P, wrong word count -> 400),
+                        "style_id"? (a POST /styles content hash),
+                        "ref_audio"? (server-side wav path, confined to
+                        serve.style.ref_dir — absolute paths and ".."
+                        escapes -> 400; disabled entirely when ref_dir
+                        is unset),
                         "priority"? (SLO class, a
                         serve.fleet.class_deadline_ms key — default
                         serve.fleet.default_class; unknown class -> 400)}
@@ -31,6 +40,19 @@ API (request schema — every field but "text" optional):
                        window one precompiled lattice dispatch. Cuts
                        time-to-first-audio to the first-window bound;
                        serve_ttfa_seconds records it
+  POST /styles         upload a reference wav (raw audio/wav body, or
+                       JSON {"ref_audio": <ref_dir-relative path>}) ->
+                       {"style_id": sha256-of-bytes, "ref_frames",
+                       "speaker", "cached"}. Content-addressed and
+                       idempotent: re-uploading the same bytes returns
+                       the same style_id with "cached": true and runs
+                       ZERO encoder work. "?speaker=NAME" (or a JSON
+                       "speaker" field) binds the style to a registry
+                       speaker; /synthesize then rejects that style_id
+                       under a different explicit speaker
+  GET  /styles         -> {"styles": [{style_id, ref_frames, speaker,
+                       d_model}...], "capacity"} — the resident
+                       embedding-cache entries, registration-ordered
   GET  /healthz        -> JSON view of the metrics-registry snapshot
                        (compile counter, batch occupancy, queue depth,
                        shed/rejected split) plus build info (git SHA,
@@ -104,13 +126,28 @@ def wav_stream_header(sampling_rate: int) -> bytes:
 
 
 class TextFrontend:
-    """Host-side request preparation: G2P + reference-mel cache."""
+    """Host-side request preparation: G2P, speaker registry, style
+    resolution.
 
-    def __init__(self, cfg: Config, default_ref_mel: Optional[np.ndarray]):
+    Style resolution order: ``style_id`` (embedding-cache lookup) ->
+    ``ref_audio`` (a ``serve.style.ref_dir``-confined server-side path,
+    content-addressed through the StyleService so repeats never re-run
+    the encoder) -> the server's default reference. The pre-style-service
+    per-path mel dict this class used to keep is gone — the bounded
+    content-addressed cache in StyleService is the one caching layer
+    (jaxlint JL012 bans unbounded caches under serving/).
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        default_ref_mel: Optional[np.ndarray],
+        style=None,  # StyleService; the server wires its backend's in
+    ):
         self.cfg = cfg
         self.default_ref_mel = default_ref_mel
-        self._mel_cache: Dict[str, np.ndarray] = {}
-        self._cache_lock = threading.Lock()
+        self.style = style
+        self._lexicon = None  # loaded on first per-word-control request
         pp = cfg.preprocess
         self.lexicon_path = pp.path.lexicon_path or None
         speakers_path = os.path.join(
@@ -131,75 +168,205 @@ class TextFrontend:
         return np.asarray(seq, np.int32)
 
     def speaker(self, spec) -> int:
+        """Registry-validated speaker resolution: names must exist in
+        speakers.json; numeric ids must fall inside the registry when
+        one is loaded (an unknown id would silently index a random
+        embedding row — the multi-speaker API validates instead)."""
         if isinstance(spec, int):
-            return spec
-        s = str(spec)
-        if s in self.speaker_map:
-            return self.speaker_map[s]
-        if s.lstrip("-").isdigit():
-            return int(s)
-        raise ValueError(f"unknown speaker {spec!r}")
+            idx = spec
+        else:
+            s = str(spec)
+            if s in self.speaker_map:
+                return self.speaker_map[s]
+            if not s.lstrip("-").isdigit():
+                raise ValueError(f"unknown speaker {spec!r}")
+            idx = int(s)
+        if self.speaker_map and not (
+            0 <= idx < max(len(self.speaker_map),
+                           max(self.speaker_map.values()) + 1)
+        ):
+            raise ValueError(
+                f"speaker id {idx} outside the registry "
+                f"(0..{len(self.speaker_map) - 1})"
+            )
+        return idx
 
-    def ref_mel(self, path: Optional[str]) -> np.ndarray:
-        if path is None:
-            if self.default_ref_mel is None:
+    def resolve_style(self, payload: Dict):
+        """(style_vectors | None, ref_mel | None) for one request payload
+        — exactly one of the two is non-None."""
+        if not self.cfg.model.use_reference_encoder:
+            return None, None  # no FiLM conditioning in this model
+        style_id = payload.get("style_id")
+        ref_audio = payload.get("ref_audio")
+        if style_id is not None and ref_audio is not None:
+            raise ValueError('pass "style_id" OR "ref_audio", not both')
+        if style_id is not None:
+            if self.style is None:
                 raise ValueError(
-                    "no reference mel: pass \"ref_audio\" (a server-side "
-                    "wav path) or start the server with --ref_audio"
+                    "style_id requires a style service (the model has no "
+                    "reference encoder)"
                 )
-            return self.default_ref_mel
-        with self._cache_lock:
-            mel = self._mel_cache.get(path)
-        if mel is None:
-            mel = load_ref_mel(self.cfg, path)
-            with self._cache_lock:
-                self._mel_cache[path] = mel
-        return mel
+            entry = self.style.get(str(style_id))
+            if entry is None:
+                raise ValueError(
+                    f"unknown style_id {style_id!r} (upload the reference "
+                    "via POST /styles first)"
+                )
+            return entry, None
+        if ref_audio is not None:
+            path = confined_ref_path(self.cfg, str(ref_audio))
+            if self.style is not None:
+                with open(path, "rb") as f:
+                    return self.style.encode_wav_bytes(f.read()), None
+            return None, load_ref_mel(self.cfg, path)
+        if self.default_ref_mel is None:
+            raise ValueError(
+                'no reference style: pass "style_id" (POST /styles), '
+                '"ref_audio" (a serve.style.ref_dir path), or start the '
+                "server with --ref_audio"
+            )
+        if self.style is not None:
+            return self.style.encode_mel(self.default_ref_mel), None
+        return None, self.default_ref_mel
+
+    def controls_and_sequence(self, text: str, payload: Dict):
+        """(sequence, p/e/d controls) for one request. Scalar controls
+        ride the plain G2P path; a per-WORD list (the notebooks'
+        fine-control workflow, e.g. ``"duration_control": [1.0, 2.5,
+        1.0]``) needs word→phoneme spans, so English text goes through
+        the span-preserving G2P and each list expands to a per-phoneme
+        array the engine pads to the dispatch bucket."""
+        keys = ("pitch_control", "energy_control", "duration_control")
+        raw = {}
+        for key in keys:
+            v = payload.get(key, 1.0)
+            if isinstance(v, bool) or not (
+                isinstance(v, (int, float))
+                or (isinstance(v, list)
+                    and v and all(isinstance(x, (int, float)) for x in v))
+            ):
+                raise ValueError(
+                    f"{key} must be a number or a per-word list of numbers"
+                )
+            raw[key] = v
+        if not any(isinstance(v, list) for v in raw.values()):
+            return self.sequence(text), [float(raw[k]) for k in keys]
+        if self.cfg.preprocess.preprocessing.text.language != "en":
+            raise ValueError(
+                "per-word control lists require English text (word spans "
+                "come from the English G2P)"
+            )
+        from speakingstyle_tpu.control import (
+            english_word_spans,
+            expand_word_controls,
+            spans_to_sequence,
+        )
+        from speakingstyle_tpu.text.g2p import read_lexicon
+
+        if self._lexicon is None:
+            self._lexicon = (
+                read_lexicon(self.lexicon_path) if self.lexicon_path else {}
+            )
+        spans = english_word_spans(text, self._lexicon)
+        sequence = spans_to_sequence(
+            spans, self.cfg.preprocess.preprocessing.text.text_cleaners
+        )
+        controls = []
+        for key in keys:
+            v = raw[key]
+            if isinstance(v, list):
+                if len(v) != len(spans):
+                    raise ValueError(
+                        f"{key} lists one factor per word: got {len(v)} "
+                        f"factors for {len(spans)} words"
+                    )
+                controls.append(np.asarray(
+                    expand_word_controls(spans, [float(x) for x in v]),
+                    np.float32,
+                ))
+            else:
+                controls.append(float(v))
+        return sequence, controls
 
     def request(self, req_id: str, payload: Dict) -> SynthesisRequest:
         text = payload.get("text")
         if not text or not isinstance(text, str):
             raise ValueError('payload must carry a non-empty "text" string')
 
-        def ctl(key):
-            v = payload.get(key, 1.0)
-            if isinstance(v, (int, float)):
-                return float(v)
-            raise ValueError(f"{key} must be a number (scalar control)")
-
         priority = payload.get("priority")
         if priority is not None and not isinstance(priority, str):
             raise ValueError("priority must be a string class name")
+        style_vec, ref_mel = self.resolve_style(payload)
+        spec = payload.get("speaker_id", payload.get("speaker"))
+        speaker = self.speaker(spec) if spec is not None else 0
+        # per-speaker style validation: a style bound to a registry
+        # speaker (POST /styles?speaker=NAME) refuses to drive a
+        # different explicit speaker — mixing them is almost always a
+        # client bug in a multi-speaker deployment
+        if style_vec is not None and style_vec.speaker is not None:
+            bound = self.speaker(style_vec.speaker)
+            if spec is None:
+                speaker = bound
+            elif speaker != bound:
+                raise ValueError(
+                    f"style {style_vec.key[:12]}... is bound to speaker "
+                    f"{style_vec.speaker!r}; request named a different "
+                    "speaker"
+                )
+        sequence, (p_c, e_c, d_c) = self.controls_and_sequence(text, payload)
         return SynthesisRequest(
             id=req_id,
-            sequence=self.sequence(text),
-            ref_mel=self.ref_mel(payload.get("ref_audio")),
-            speaker=self.speaker(payload.get("speaker_id", 0)),
+            sequence=sequence,
+            ref_mel=ref_mel,
+            style=style_vec,
+            speaker=speaker,
             raw_text=text,
-            p_control=ctl("pitch_control"),
-            e_control=ctl("energy_control"),
-            d_control=ctl("duration_control"),
+            p_control=p_c,
+            e_control=e_c,
+            d_control=d_c,
             priority=priority,
         )
 
 
+def confined_ref_path(cfg: Config, path: str) -> str:
+    """Resolve a request-supplied server-side reference path inside the
+    ``serve.style.ref_dir`` allowlist. Absolute paths, ``..`` segments,
+    and symlink escapes are rejected (ValueError -> HTTP 400); with no
+    ref_dir configured, path-based references are disabled entirely —
+    uploads go through POST /styles."""
+    ref_dir = cfg.serve.style.ref_dir
+    if not ref_dir:
+        raise ValueError(
+            'server-side "ref_audio" paths are disabled (serve.style.'
+            "ref_dir is unset): upload the reference via POST /styles"
+        )
+    norm = path.replace("\\", "/")
+    if os.path.isabs(path) or ".." in norm.split("/"):
+        raise ValueError(
+            f"ref_audio path {path!r} escapes the reference directory"
+        )
+    base = os.path.realpath(ref_dir)
+    full = os.path.realpath(os.path.join(base, path))
+    if os.path.commonpath([base, full]) != base:
+        raise ValueError(
+            f"ref_audio path {path!r} escapes the reference directory"
+        )
+    if not os.path.isfile(full):
+        raise ValueError(f"ref_audio path {path!r} does not exist")
+    return full
+
+
 def load_ref_mel(cfg: Config, wav_path: str) -> np.ndarray:
     """Reference wav -> [T, n_mels] normalized log-mel (CLI single-mode
-    pipeline, shared with cli/synthesize.py)."""
-    from speakingstyle_tpu.audio.stft import MelExtractor, get_mel_from_wav
+    pipeline, shared with cli/synthesize.py). Trusted-path helper: the
+    HTTP layer never calls this with request-supplied paths except
+    through ``confined_ref_path``."""
     from speakingstyle_tpu.audio.tools import load_wav
+    from speakingstyle_tpu.serving.style import mel_from_wav_array
 
     pp = cfg.preprocess.preprocessing
     wav, _ = load_wav(wav_path, target_sr=pp.audio.sampling_rate)
-    mel, _ = get_mel_from_wav(
-        wav,
-        MelExtractor(
-            pp.stft.filter_length, pp.stft.hop_length, pp.stft.win_length,
-            pp.mel.n_mel_channels, pp.audio.sampling_rate,
-            pp.mel.mel_fmin, pp.mel.mel_fmax,
-        ),
-    )
-    return np.asarray(mel.T, np.float32)  # [T, n_mels]
+    return mel_from_wav_array(cfg, wav)
 
 
 class SynthesisServer:
@@ -233,6 +400,15 @@ class SynthesisServer:
         self.registry = (
             router.registry if router is not None else engine.registry
         )
+        # ONE style service serves the whole deployment: the router's
+        # shared instance in fleet mode, the engine's otherwise. The
+        # frontend resolves styles through it (cache-first in the handler
+        # thread), and /styles reads+registers against it.
+        self.style = (
+            router.style if router is not None else engine.style
+        )
+        if frontend is not None and getattr(frontend, "style", None) is None:
+            frontend.style = self.style
         self.events = events
         if router is not None:
             self.batcher = None
@@ -333,17 +509,87 @@ class SynthesisServer:
                         "programs": outer.programs(),
                         "build": outer.build,
                     })
+                if self.path == "/styles":
+                    if outer.style is None:
+                        return self._json(400, {
+                            "error": "no style service (the model has no "
+                                     "reference encoder)"
+                        })
+                    return self._json(200, {
+                        "styles": outer.style.styles(),
+                        "capacity": outer.style.cfg.serve.style.cache_capacity,
+                    })
                 return self._json(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
                 parsed = urlparse(self.path)
                 if parsed.path == "/debug/profile":
                     return self._profile(parsed)
+                if parsed.path == "/styles":
+                    return self._post_style(parsed)
                 if parsed.path == "/synthesize/stream":
                     return self._synthesize(parsed, stream=True)
                 if parsed.path == "/synthesize":
                     return self._synthesize(parsed, stream=False)
                 return self._json(404, {"error": f"no route {self.path}"})
+
+            def _post_style(self, parsed):
+                """Register a reference style: raw wav bytes in the body
+                (audio/wav), or JSON {"ref_audio": <confined path>}.
+                Content-addressed: the style_id IS the sha256 of the
+                reference bytes, so the operation is idempotent and a
+                repeat upload performs zero encoder work."""
+                if outer.style is None:
+                    return self._json(400, {
+                        "error": "no style service (the model has no "
+                                 "reference encoder)"
+                    })
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = self.rfile.read(n) if n else b""
+                    ctype = (self.headers.get("Content-Type") or "").lower()
+                    speaker = None
+                    q = parse_qs(parsed.query)
+                    if "speaker" in q:
+                        speaker = q["speaker"][0]
+                    if ctype.startswith("application/json"):
+                        payload = json.loads(body or b"{}")
+                        speaker = payload.get("speaker", speaker)
+                        ref = payload.get("ref_audio")
+                        if not ref:
+                            raise ValueError(
+                                'JSON style registration needs "ref_audio" '
+                                "(a serve.style.ref_dir path); raw wav "
+                                "uploads go in an audio/wav body"
+                            )
+                        # the frontend's cfg carries serve.style.ref_dir
+                        # (same source resolve_style confines against)
+                        ref_cfg = (
+                            outer.frontend.cfg
+                            if outer.frontend is not None else outer.cfg
+                        )
+                        with open(confined_ref_path(
+                            ref_cfg, str(ref)
+                        ), "rb") as f:
+                            body = f.read()
+                    elif not body:
+                        raise ValueError(
+                            "empty body: POST the reference wav bytes "
+                            '(audio/wav) or JSON {"ref_audio": ...}'
+                        )
+                    if speaker is not None and outer.frontend is not None:
+                        outer.frontend.speaker(speaker)  # registry check
+                    key = outer.style.digest_bytes(body)
+                    entry = outer.style.get(key)
+                    cached = entry is not None
+                    if entry is None:
+                        entry = outer.style.encode_wav_bytes(
+                            body, speaker=speaker
+                        )
+                except (ValueError, RequestTooLarge) as e:
+                    return self._json(400, {"error": str(e)})
+                out = dict(entry.as_dict(), cached=cached)
+                return self._json(200, out)
 
             def _synthesize(self, parsed, stream: bool):
                 # the req_id is minted HERE and rides through frontend ->
@@ -543,13 +789,16 @@ class SynthesisServer:
 
     def programs(self):
         """ProgramCard dicts across every live engine (fleet: replicas
-        in index order)."""
+        in index order), then the shared style-encoder programs once."""
         if self.router is not None:
             out = []
             for engine in self.router.engines():
                 out.extend(engine.programs())
-            return out
-        return self.engine.programs()
+        else:
+            out = list(self.engine.programs())
+        if self.style is not None:
+            out.extend(self.style.programs())
+        return out
 
     def _request_done(
         self, req_id: str, path: str, status: int, t0: float
@@ -616,6 +865,24 @@ class SynthesisServer:
             "shed": int(counters.get("serve_shed_total", 0)),
             "rejected": int(counters.get("serve_rejected_total", 0)),
             "active_streams": int(gauges.get("serve_active_streams", 0)),
+            # the style path's accounting: cached-style requests must
+            # show up as hits with the encode counter standing still
+            "style": {
+                "entries": int(gauges.get("serve_style_cache_entries", 0)),
+                "hits": int(counters.get("serve_style_cache_hits_total", 0)),
+                "misses": int(
+                    counters.get("serve_style_cache_misses_total", 0)
+                ),
+                "evictions": int(
+                    counters.get("serve_style_cache_evictions_total", 0)
+                ),
+                "compiles": int(
+                    counters.get("serve_style_compiles_total", 0)
+                ),
+                "encodes": int(
+                    counters.get("serve_style_dispatches_total", 0)
+                ),
+            },
         }
         if self.router is not None:
             out["replicas"] = {

@@ -1,0 +1,474 @@
+"""StyleService: the AOT reference-encoder subsystem + embedding cache.
+
+The paper's headline capability — a reference utterance driving
+FiLM-conditioned synthesis — used to be fused into every synthesis
+dispatch: the reference encoder re-ran inside the acoustic program, and
+the reference mel shared the ``T_mel`` bucket axis with the free-run
+output buffer, so a long reference inflated the whole dispatch. This
+module splits the serve-time model along the line the data suggests
+(styles repeat; text does not):
+
+  * **Its own lattice.** Reference mels ride a ``(batch, ref_len)``
+    bucket grid (``serve.style.ref_buckets`` — lattice.StyleLattice),
+    AOT-precompiled like the synthesis lattice, so the style path
+    inherits the zero-steady-state-compiles property: every encoder
+    execution is a precompiled program at a covered shape; a miss
+    compiles once under a lock and is counted
+    (``serve_style_compiles_total`` + the jax.monitoring backend bus).
+
+  * **A content-addressed LRU cache.** ``sha256(reference bytes)`` keys
+    the FiLM ``(gamma, beta)`` vectors the encoder produced (a few KB
+    per entry vs re-running 4 FFT blocks over up to 1000 mel frames).
+    A repeat style performs ZERO encoder dispatches — the acceptance
+    invariant, asserted via ``serve_style_cache_hits_total`` against
+    ``serve_style_dispatches_total``. The cache is bounded
+    (``serve.style.cache_capacity``; jaxlint JL012 bans unbounded
+    caches under serving/) with LRU eviction and an eviction counter.
+
+  * **One service, N consumers.** The synthesis engine consumes styles
+    (requests carry precomputed vectors, or a raw reference mel the
+    engine resolves through this service at dispatch), the HTTP layer
+    registers them (``POST /styles`` -> ``style_id`` == the content
+    hash), the CLI batch path dedups through them, and the fleet router
+    shares ONE StyleService across all replicas — a style uploaded once
+    is warm for every replica.
+
+Parity note: the reference's mean-pool divides by the PADDED length
+(models/reference_encoder.py, ``true_length_mean=False``), so (gamma,
+beta) depend on which ref bucket a reference lands in. That dependence
+is deterministic here — a given reference length always covers to the
+same ``serve.style.ref_buckets`` point — which is *more* stable than the
+fused path it replaces, where the same reference was padded to whatever
+``T_mel`` bucket the co-batched text happened to need.
+"""
+
+import contextlib
+import hashlib
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.obs import MetricsRegistry
+from speakingstyle_tpu.obs.cost import ProgramCard, publish_program_gauges
+from speakingstyle_tpu.serving.lattice import StyleLattice
+
+__all__ = [
+    "StyleService",
+    "StyleVectors",
+    "mel_from_wav_array",
+    "style_bucket_label",
+]
+
+
+def mel_from_wav_array(cfg: Config, wav: np.ndarray) -> np.ndarray:
+    """Float wav samples -> [T, n_mels] normalized log-mel, the exact
+    feature pipeline the preprocessor/CLI use (shared here so the upload
+    path and the server-side-path path extract identical features)."""
+    from speakingstyle_tpu.audio.stft import MelExtractor, get_mel_from_wav
+
+    pp = cfg.preprocess.preprocessing
+    mel, _ = get_mel_from_wav(
+        np.asarray(wav, np.float32),
+        MelExtractor(
+            pp.stft.filter_length, pp.stft.hop_length, pp.stft.win_length,
+            pp.mel.n_mel_channels, pp.audio.sampling_rate,
+            pp.mel.mel_fmin, pp.mel.mel_fmax,
+        ),
+    )
+    return np.asarray(mel.T, np.float32)  # [T, n_mels]
+
+
+def style_bucket_label(point: Tuple[int, int]) -> str:
+    """Stable metric-label spelling of a style lattice point: ``b4.r512``."""
+    return f"b{point[0]}.r{point[1]}"
+
+
+@dataclass(frozen=True)
+class StyleVectors:
+    """One encoded speaking style: the FiLM conditioning pair.
+
+    ``key`` is the content address (sha256 hex of the reference bytes) —
+    it doubles as the public ``style_id`` the HTTP API hands out.
+    """
+
+    key: str
+    gamma: np.ndarray            # [d_model] float32
+    beta: np.ndarray             # [d_model] float32
+    ref_frames: int = 0          # reference length before padding
+    speaker: Optional[str] = None  # registry label the style is bound to
+    created_seq: int = 0         # registration order (GET /styles sorting)
+
+    def as_dict(self) -> Dict:
+        """JSON-ready metadata (vectors themselves stay server-side)."""
+        return {
+            "style_id": self.key,
+            "ref_frames": int(self.ref_frames),
+            "speaker": self.speaker,
+            "d_model": int(self.gamma.shape[-1]),
+        }
+
+
+@contextlib.contextmanager
+def _quiet_donation():
+    """CPU cannot always honor donation; jax warns per lowering. The
+    donation here is best-effort by design — silence exactly that."""
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        yield
+
+
+class StyleService:
+    """AOT reference-encoder programs + content-addressed (gamma, beta) cache.
+
+    ``variables`` is the full acoustic-model variable tree (the engine's
+    checkpoint); the service extracts the ``reference_encoder`` subtree,
+    so engine and service always run the same encoder weights. Pass a
+    shared ``registry`` (the fleet does) to aggregate metrics.
+    """
+
+    def __init__(
+        self,
+        cfg: Config,
+        variables: Dict,
+        registry: Optional[MetricsRegistry] = None,
+        speaker_map: Optional[Dict[str, int]] = None,
+    ):
+        from speakingstyle_tpu.models.factory import (
+            reference_encoder_from_config,
+        )
+
+        if not cfg.model.use_reference_encoder:
+            raise ValueError(
+                "StyleService requires model.use_reference_encoder=true"
+            )
+        params = variables.get("params", {}).get("reference_encoder")
+        if params is None:
+            raise ValueError(
+                "variables carry no 'reference_encoder' params — the "
+                "StyleService must run the checkpoint's own encoder weights"
+            )
+        self.cfg = cfg
+        self.lattice = StyleLattice.from_config(cfg.serve)
+        self.variables = {"params": params}
+        # position tables are build-time constants, sized to this
+        # service's own ref buckets (checkpoint-safe, like the engine's)
+        self.module = reference_encoder_from_config(
+            cfg,
+            n_position=max(self.lattice.max_ref, cfg.model.max_seq_len) + 1,
+        )
+        self.d_model = cfg.model.reference_encoder.encoder_hidden
+        self.n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+        # speaker registry (speakers.json): style entries may be bound to
+        # a label; /synthesize validates requested speakers against it
+        self.speaker_map: Dict[str, int] = dict(speaker_map or {})
+
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._hits = self.registry.counter(
+            "serve_style_cache_hits_total",
+            help="style lookups served from the embedding cache",
+        )
+        self._misses = self.registry.counter(
+            "serve_style_cache_misses_total",
+            help="style lookups that had to run the reference encoder",
+        )
+        self._evictions = self.registry.counter(
+            "serve_style_cache_evictions_total",
+            help="LRU evictions from the bounded embedding cache",
+        )
+        self._entries_gauge = self.registry.gauge(
+            "serve_style_cache_entries",
+            help="styles currently resident in the embedding cache",
+        )
+        self._compiles = self.registry.counter(
+            "serve_style_compiles_total",
+            help="reference-encoder programs compiled (precompile + misses)",
+        )
+        self._dispatches = self.registry.counter(
+            "serve_style_dispatches_total",
+            help="reference-encoder device dispatches executed",
+        )
+
+        self._capacity = cfg.serve.style.cache_capacity
+        self._entries: "OrderedDict[str, StyleVectors]" = OrderedDict()
+        self._seq = 0
+        self._cache_lock = threading.Lock()
+        self._exe: Dict[Tuple[int, int], object] = {}
+        self._cards: Dict[Tuple[int, int], ProgramCard] = {}
+        self._compile_lock = threading.Lock()
+
+    # -- content addressing --------------------------------------------------
+
+    @staticmethod
+    def digest_bytes(data: bytes) -> str:
+        """The content address of a reference: sha256 hex of its bytes.
+        This IS the public ``style_id`` — uploads are idempotent."""
+        return hashlib.sha256(data).hexdigest()
+
+    @classmethod
+    def digest_mel(cls, mel: np.ndarray) -> str:
+        """Content address of an already-extracted [T, n_mels] mel (the
+        engine-side fallback when no wav bytes exist)."""
+        m = np.ascontiguousarray(mel, np.float32)
+        return cls.digest_bytes(
+            repr(m.shape).encode() + m.tobytes()
+        )
+
+    # -- compilation ---------------------------------------------------------
+
+    @property
+    def compile_count(self) -> int:
+        return int(self._compiles.value)
+
+    @property
+    def dispatch_count(self) -> int:
+        return int(self._dispatches.value)
+
+    def programs(self) -> List[Dict]:
+        """JSON-ready ProgramCards, smallest point first (joins the
+        engine's cards in ``GET /debug/programs``)."""
+        return [
+            self._cards[p].as_dict()
+            for p in sorted(self._cards, key=lambda p: p[0] * p[1])
+        ]
+
+    def _encode_fn(self, r: int):
+        from speakingstyle_tpu.ops.masking import length_to_mask
+
+        def fn(variables, mels, mel_lens):
+            import jax.numpy as jnp
+
+            pad_mask = length_to_mask(mel_lens, r)
+            gammas, betas = self.module.apply(
+                variables, mels, pad_mask, deterministic=True
+            )
+            return (
+                gammas[:, 0, :].astype(jnp.float32),
+                betas[:, 0, :].astype(jnp.float32),
+            )
+
+        return fn
+
+    def _compile_point(self, point: Tuple[int, int]) -> None:
+        """Caller holds ``_compile_lock``."""
+        import jax
+        import jax.numpy as jnp
+
+        b, r = point
+        s = jax.ShapeDtypeStruct
+        donate = (1, 2) if self.cfg.serve.donate_buffers else ()
+        jitted = jax.jit(self._encode_fn(r), donate_argnums=donate)
+        with _quiet_donation():
+            exe = jitted.lower(
+                self.variables,
+                s((b, r, self.n_mels), jnp.float32),
+                s((b,), jnp.int32),
+            ).compile()
+        self._exe[point] = exe
+        self._compiles.inc()
+        label = style_bucket_label(point)
+        card = ProgramCard.from_compiled(exe, name=f"style:{label}")
+        self._cards[point] = card
+        publish_program_gauges(
+            self.registry, card, "serve",
+            labels={"kind": "style", "bucket": label},
+        )
+
+    def precompile(self) -> float:
+        """AOT-compile every (batch, ref_len) point; returns wall
+        seconds. Idempotent — the fleet's replicas share one service, so
+        only the first warm-up pays (JL008's sanctioned compile loop)."""
+        t0 = time.monotonic()
+        with self._compile_lock:
+            for point in self.lattice.points():
+                if point not in self._exe:
+                    self._compile_point(point)
+        return time.monotonic() - t0
+
+    @property
+    def is_ready(self) -> bool:
+        return len(self._exe) >= len(self.lattice)
+
+    # -- cache ---------------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._cache_lock:
+            return len(self._entries)
+
+    def __bool__(self) -> bool:
+        """A service with an empty cache is still a service — without
+        this, ``if engine.style:`` silently means "cache non-empty"
+        (len-based truthiness), which is never the intended question."""
+        return True
+
+    def get(self, style_id: str) -> Optional[StyleVectors]:
+        """Cache lookup by style_id; counts a hit (and refreshes LRU
+        order) or nothing — a plain miss here is the caller's 404, not
+        an encoder run, so it is not counted as a cache miss."""
+        with self._cache_lock:
+            entry = self._entries.get(style_id)
+            if entry is not None:
+                self._entries.move_to_end(style_id)
+                self._hits.inc()
+        return entry
+
+    def _insert(self, entry: StyleVectors) -> StyleVectors:
+        """Caller does NOT hold the cache lock."""
+        with self._cache_lock:
+            existing = self._entries.get(entry.key)
+            if existing is not None:
+                self._entries.move_to_end(entry.key)
+                return existing
+            self._seq += 1
+            entry = StyleVectors(
+                key=entry.key, gamma=entry.gamma, beta=entry.beta,
+                ref_frames=entry.ref_frames, speaker=entry.speaker,
+                created_seq=self._seq,
+            )
+            self._entries[entry.key] = entry
+            while len(self._entries) > self._capacity:
+                self._entries.popitem(last=False)
+                self._evictions.inc()
+            self._entries_gauge.set(len(self._entries))
+        return entry
+
+    def styles(self) -> List[Dict]:
+        """Registration-ordered metadata of resident styles (the
+        ``GET /styles`` payload)."""
+        with self._cache_lock:
+            entries = sorted(
+                self._entries.values(), key=lambda e: e.created_seq
+            )
+        return [e.as_dict() for e in entries]
+
+    # -- encoding ------------------------------------------------------------
+
+    def encode_mels(
+        self,
+        mels: Sequence[np.ndarray],
+        keys: Optional[Sequence[Optional[str]]] = None,
+        speaker: Optional[str] = None,
+    ) -> List[StyleVectors]:
+        """Resolve a batch of reference mels to StyleVectors.
+
+        Cache-first: hits return immediately (zero device work); the
+        distinct misses batch-encode through the smallest covering
+        ``(batch, ref_len)`` programs, grouped by ref bucket and chunked
+        at the lattice's max batch. Duplicate references within one call
+        encode once.
+        """
+        keys = list(keys) if keys is not None else [None] * len(mels)
+        resolved: Dict[int, StyleVectors] = {}
+        pending: "OrderedDict[str, List[int]]" = OrderedDict()
+        pending_mel: Dict[str, np.ndarray] = {}
+        for i, mel in enumerate(mels):
+            key = keys[i] or self.digest_mel(mel)
+            entry = self.get(key)
+            if entry is not None:
+                resolved[i] = entry
+                continue
+            self._misses.inc()
+            pending.setdefault(key, []).append(i)
+            pending_mel[key] = np.asarray(mel, np.float32)
+
+        if pending:
+            # group distinct misses by covering ref bucket so one
+            # encoder dispatch serves same-bucket references together
+            by_bucket: "OrderedDict[int, List[str]]" = OrderedDict()
+            for key in pending:
+                _, r = self.lattice.cover(1, pending_mel[key].shape[0])
+                by_bucket.setdefault(r, []).append(key)
+            for r, bucket_keys in by_bucket.items():
+                cap = self.lattice.max_batch
+                for at in range(0, len(bucket_keys), cap):
+                    chunk = bucket_keys[at: at + cap]
+                    for key, entry in zip(
+                        chunk, self._encode_chunk(
+                            [pending_mel[k] for k in chunk], r, speaker,
+                            chunk,
+                        )
+                    ):
+                        for i in pending[key]:
+                            resolved[i] = entry
+        return [resolved[i] for i in range(len(mels))]
+
+    def encode_mel(
+        self, mel: np.ndarray, key: Optional[str] = None,
+        speaker: Optional[str] = None,
+    ) -> StyleVectors:
+        return self.encode_mels([mel], keys=[key], speaker=speaker)[0]
+
+    def encode_wav_bytes(
+        self, data: bytes, speaker: Optional[str] = None
+    ) -> StyleVectors:
+        """Reference wav bytes -> StyleVectors, content-addressed by the
+        BYTES (the upload path: the style_id is reproducible from the
+        file alone). Cache hits skip mel extraction too."""
+        key = self.digest_bytes(data)
+        entry = self.get(key)
+        if entry is not None:
+            return entry
+        import io
+
+        from speakingstyle_tpu.audio.tools import load_wav
+
+        wav, _ = load_wav(
+            io.BytesIO(data),
+            target_sr=self.cfg.preprocess.preprocessing.audio.sampling_rate,
+        )
+        mel = mel_from_wav_array(self.cfg, wav)
+        return self.encode_mel(mel, key=key, speaker=speaker)
+
+    def _encode_chunk(
+        self,
+        mels: List[np.ndarray],
+        r: int,
+        speaker: Optional[str],
+        chunk_keys: List[str],
+    ) -> List[StyleVectors]:
+        """One padded encoder dispatch: compile-on-miss (counted, under
+        the lock), pad, execute, read back, insert into the cache."""
+        import jax
+
+        point = self.lattice.cover(len(mels), r)
+        with self._compile_lock:
+            if point not in self._exe:
+                self._compile_point(point)
+        b, r = point
+        t0 = time.monotonic()
+        padded = np.zeros((b, r, self.n_mels), np.float32)
+        lens = np.zeros((b,), np.int32)
+        for i, mel in enumerate(mels):
+            padded[i, : mel.shape[0]] = mel
+            lens[i] = mel.shape[0]
+        gammas_dev, betas_dev = self._exe[point](
+            self.variables, jax.device_put(padded), jax.device_put(lens)
+        )
+        # read back INSIDE the timed region: the histogram must measure
+        # device execution, not async enqueue (the JL010 discipline)
+        gammas = np.asarray(gammas_dev)
+        betas = np.asarray(betas_dev)
+        self._dispatches.inc()
+        self.registry.histogram(
+            "serve_style_encode_seconds",
+            labels={"bucket": style_bucket_label(point)},
+            help="wall time of one padded reference-encoder dispatch",
+        ).observe(time.monotonic() - t0)
+        out = []
+        for i, (key, mel) in enumerate(zip(chunk_keys, mels)):
+            out.append(self._insert(StyleVectors(
+                key=key,
+                gamma=gammas[i].copy(),
+                beta=betas[i].copy(),
+                ref_frames=int(mel.shape[0]),
+                speaker=speaker,
+            )))
+        return out

@@ -660,6 +660,82 @@ def test_jl011_negative_outside_serving():
 
 
 # ---------------------------------------------------------------------------
+# JL012 — unbounded caches in serving code
+# ---------------------------------------------------------------------------
+
+
+def test_jl012_positive_dict_cache_in_serving():
+    assert "JL012" in _codes("""
+        class Frontend:
+            def __init__(self):
+                self._mel_cache = {}
+    """, path=_SERVING_PATH)
+
+
+def test_jl012_positive_annotated_dict_cache():
+    assert "JL012" in _codes("""
+        from typing import Dict
+
+        class Frontend:
+            def __init__(self):
+                self.style_cache: Dict[str, bytes] = dict()
+    """, path=_SERVING_PATH)
+
+
+def test_jl012_positive_lru_cache_maxsize_none_and_functools_cache():
+    src = """
+        import functools
+        from functools import lru_cache
+
+        @lru_cache(maxsize=None)
+        def embed(key):
+            return key
+
+        @functools.cache
+        def lookup(key):
+            return key
+    """
+    details = sorted({
+        f.detail for f in linter.lint_source(
+            textwrap.dedent(src), _SERVING_PATH
+        ) if f.rule == "JL012"
+    })
+    assert len(details) == 2
+
+
+def test_jl012_negative_bounded_lru_and_non_cache_dicts():
+    # bare lru_cache() keeps the stdlib's bounded default of 128;
+    # non-cache-named dicts (routing tables, program maps) are state,
+    # not caches — both stay silent
+    assert "JL012" not in _codes("""
+        from functools import lru_cache
+
+        @lru_cache(maxsize=64)
+        def embed(key):
+            return key
+
+        @lru_cache()
+        def small(key):
+            return key
+
+        class Engine:
+            def __init__(self):
+                self._programs = {}
+                self.routes = dict()
+    """, path=_SERVING_PATH)
+
+
+def test_jl012_negative_outside_serving():
+    # scoped like JL011: outside serving/ an unbounded memo can be a
+    # deliberate choice (e.g. a per-process constant table)
+    assert "JL012" not in _codes("""
+        class Frontend:
+            def __init__(self):
+                self._mel_cache = {}
+    """, path="speakingstyle_tpu/training/fake.py")
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -770,12 +846,13 @@ def test_every_rule_is_non_vacuous():
     baselined) — rules that never fire are dead weight."""
     fired = {f.rule for f in linter.lint_paths()}
     fired |= {fp.split(":", 1)[0] for fp in linter.load_baseline()}
-    # JL009, JL010, and JL011 are deliberately absent: the tree already
-    # follows the monotonic-clock duration discipline, syncs (reads a
-    # device value back) inside every jit-timing region, AND bounds every
-    # serving queue, so there is nothing to baseline — the desired steady
-    # state for preventive rules; their fixtures above keep them
-    # non-vacuous.
+    # JL009–JL012 are deliberately absent: the tree already follows the
+    # monotonic-clock duration discipline, syncs (reads a device value
+    # back) inside every jit-timing region, bounds every serving queue,
+    # AND bounds every serving cache (the StyleService LRU replaced the
+    # frontend's unbounded per-path mel dict), so there is nothing to
+    # baseline — the desired steady state for preventive rules; their
+    # fixtures above keep them non-vacuous.
     for code in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
                  "JL007", "JL008"):
         assert code in fired, f"{code} never fires on the real tree"
@@ -808,11 +885,13 @@ def test_cli_check_exits_zero_on_repo():
               "    g = jax.jit(f)\n    t0 = time.monotonic()\n"
               "    y = g(x)\n    return time.monotonic() - t0\n"),
     ("JL011", "import queue\n\nq = queue.Queue()\n"),
+    ("JL012", "class F:\n    def __init__(self):\n"
+              "        self._mel_cache = {}\n"),
 ])
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
     # JL004 is scoped to training/ paths; JL007 to speakingstyle_tpu/;
-    # JL011 to speakingstyle_tpu/serving/
-    sub = "serving" if code == "JL011" else "training"
+    # JL011/JL012 to speakingstyle_tpu/serving/
+    sub = "serving" if code in ("JL011", "JL012") else "training"
     d = tmp_path / "speakingstyle_tpu" / sub
     d.mkdir(parents=True)
     f = d / "fixture.py"

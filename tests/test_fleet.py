@@ -29,6 +29,7 @@ from speakingstyle_tpu.configs.config import (
     ModelConfig,
     ReferenceEncoderConfig,
     ServeConfig,
+    StyleConfig,
     TransformerConfig,
     VarianceEmbeddingConfig,
     VariancePredictorConfig,
@@ -308,6 +309,7 @@ def _tiny_cfg(**fleet_kw):
             batch_buckets=[1, 2], src_buckets=[16], mel_buckets=[32],
             frames_per_phoneme=2, max_wait_ms=20.0,
             fleet=FleetConfig(**fleet),
+            style=StyleConfig(ref_buckets=[32]),
         ),
     )
 

@@ -24,6 +24,7 @@ from speakingstyle_tpu.configs.config import (
     ModelConfig,
     ReferenceEncoderConfig,
     ServeConfig,
+    StyleConfig,
     TransformerConfig,
     VarianceEmbeddingConfig,
     VariancePredictorConfig,
@@ -348,6 +349,7 @@ def _tiny_cfg(**serve_kw):
     serve = dict(
         batch_buckets=[1, 2], src_buckets=[16], mel_buckets=[32],
         frames_per_phoneme=2, max_wait_ms=20.0,
+        style=StyleConfig(ref_buckets=[32]),
     )
     serve.update(serve_kw)
     return Config(
@@ -665,8 +667,13 @@ def test_debug_programs_endpoint(tiny_engine):
         resp = conn.getresponse()
         body = json.loads(resp.read())
         assert resp.status == 200
-        assert body["programs"] == tiny_engine.programs()
-        assert len(body["programs"]) == tiny_engine.compile_count
+        # engine programs first, then the style-encoder programs once
+        assert body["programs"] == (
+            tiny_engine.programs() + tiny_engine.style.programs()
+        )
+        assert len(body["programs"]) == (
+            tiny_engine.compile_count + tiny_engine.style.compile_count
+        )
         assert body["build"]["backend"]
         conn.close()
     finally:

@@ -1,0 +1,557 @@
+"""Style service: AOT reference-encoder subsystem + embedding cache.
+
+Four layers, mirroring serving/style.py's role in the stack:
+  1. StyleLattice — pure-python (batch, ref_len) covering properties,
+     including the decoupling win: a max-length reference no longer
+     inflates the synthesis T_mel bucket;
+  2. cache — content addressing, hit/miss/eviction counters, LRU order;
+  3. engine parity — synthesis from cached (gamma, beta) is BIT-IDENTICAL
+     to the ref_mel path, and a cached-style request performs zero
+     reference-encoder dispatches and zero XLA compiles (the acceptance
+     invariants, checked on the backend monitoring bus);
+  4. HTTP — POST /styles -> style_id -> /synthesize roundtrip, ref_dir
+     path confinement (``..`` escapes -> 400), per-speaker validation.
+"""
+
+import dataclasses
+import http.client
+import io
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from speakingstyle_tpu.configs.config import (
+    Config,
+    ModelConfig,
+    ReferenceEncoderConfig,
+    ServeConfig,
+    StyleConfig,
+    TransformerConfig,
+    VarianceEmbeddingConfig,
+    VariancePredictorConfig,
+)
+from speakingstyle_tpu.serving.lattice import (
+    BucketLattice,
+    RequestTooLarge,
+    StyleLattice,
+)
+
+# ---------------------------------------------------------------------------
+# StyleLattice (pure python)
+# ---------------------------------------------------------------------------
+
+
+def test_style_lattice_cover_is_elementwise_smallest():
+    lat = StyleLattice([1, 4, 8], [64, 256, 1000])
+    rng = np.random.default_rng(0)
+    for _ in range(200):
+        n = int(rng.integers(1, 9))
+        r = int(rng.integers(1, 1001))
+        b, rb = lat.cover(n, r)
+        assert b >= n and rb >= r
+        for (pb, pr) in lat.points():
+            if pb >= n and pr >= r:
+                assert b <= pb and rb <= pr
+
+
+def test_style_lattice_too_large_names_config_key():
+    lat = StyleLattice([1], [64])
+    with pytest.raises(RequestTooLarge, match="serve.style.ref_buckets"):
+        lat.cover(1, 65)
+    with pytest.raises(RequestTooLarge, match="serve.style.batch_buckets"):
+        lat.cover(2, 10)
+
+
+def test_style_lattice_rejects_bad_axes_and_inherits_batch():
+    with pytest.raises(ValueError):
+        StyleLattice([], [64])
+    with pytest.raises(ValueError):
+        StyleLattice([1], [64, 32])
+    serve = ServeConfig(
+        batch_buckets=[1, 2], src_buckets=[16], mel_buckets=[32],
+        style=StyleConfig(ref_buckets=[32]),
+    )
+    lat = StyleLattice.from_config(serve)
+    assert lat.batch_buckets == [1, 2]  # inherited from serve
+    assert len(lat) == 2
+    explicit = StyleLattice.from_config(dataclasses.replace(
+        serve, style=StyleConfig(ref_buckets=[32], batch_buckets=[4])
+    ))
+    assert explicit.batch_buckets == [4]
+
+
+def test_style_config_validation():
+    with pytest.raises(ValueError, match="ref_buckets"):
+        StyleConfig(ref_buckets=[])
+    with pytest.raises(ValueError, match="ascending"):
+        StyleConfig(ref_buckets=[64, 32])
+    with pytest.raises(ValueError, match="cache_capacity"):
+        StyleConfig(cache_capacity=0)
+
+
+def test_ref_length_no_longer_inflates_mel_bucket():
+    """The decoupling is a strict win on bucket cover: under the old
+    ``required_mel = max(ref_len, est_out)`` a max-length reference
+    forced the largest T_mel bucket; with references on their own axis
+    the same request covers to the smallest output bucket."""
+    lat = BucketLattice([1, 4, 8], [32, 64, 128], [256, 512, 1000])
+    style = StyleLattice([1, 4, 8], [256, 512, 1000])
+    ref_len, est_out = 1000, 120  # long reference, short utterance
+    old_bucket = lat.cover(1, 10, max(ref_len, est_out))
+    new_bucket = lat.cover(1, 10, est_out)
+    assert old_bucket.t_mel == 1000
+    assert new_bucket.t_mel == 256          # strictly smaller dispatch
+    assert new_bucket.volume < old_bucket.volume
+    # and the reference still admits, on its own axis
+    assert style.cover(1, ref_len) == (1, 1000)
+
+
+# ---------------------------------------------------------------------------
+# tiny engine + service (real jax)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_cfg(**style_kw):
+    style = dict(ref_buckets=[32])
+    style.update(style_kw)
+    return Config(
+        model=ModelConfig(
+            transformer=TransformerConfig(
+                encoder_layer=1, decoder_layer=1, encoder_hidden=16,
+                decoder_hidden=16, conv_filter_size=16,
+                conv_kernel_size=(3, 1),
+            ),
+            reference_encoder=ReferenceEncoderConfig(
+                encoder_layer=1, encoder_head=2, encoder_hidden=16,
+                conv_layer=1, conv_filter_size=16,
+            ),
+            variance_predictor=VariancePredictorConfig(filter_size=16),
+            variance_embedding=VarianceEmbeddingConfig(n_bins=8),
+            postnet_embedding_dim=16, postnet_layers=2,
+            max_seq_len=48, compute_dtype="float32",
+        ),
+        serve=ServeConfig(
+            batch_buckets=[1, 2], src_buckets=[16], mel_buckets=[32],
+            frames_per_phoneme=2, max_wait_ms=20.0,
+            style=StyleConfig(**style),
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_style_engine():
+    """One precompiled tiny engine (synthesis + style lattices) shared
+    by the e2e tests."""
+    import jax
+
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.models.hifigan import Generator
+    from speakingstyle_tpu.serving.engine import SynthesisEngine
+
+    cfg = _tiny_cfg()
+    model = build_model(cfg, n_position=49)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    bias = variables["params"]["variance_adaptor"]["duration_predictor"][
+        "linear_layer"]["bias"]
+    variables["params"]["variance_adaptor"]["duration_predictor"][
+        "linear_layer"]["bias"] = bias + 1.1
+    gen = Generator(
+        upsample_rates=(2, 2), upsample_kernel_sizes=(4, 4),
+        upsample_initial_channel=16, resblock_kernel_sizes=(3,),
+        resblock_dilation_sizes=((1,),),
+    )
+    gparams = gen.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8, 80), np.float32)
+    )["params"]
+    engine = SynthesisEngine(cfg, variables, vocoder=(gen, gparams),
+                             model=model)
+    engine.precompile()
+    return engine
+
+
+def _ref(i, T=20):
+    rng = np.random.default_rng(1000 + i)
+    return rng.standard_normal((T, 80)).astype(np.float32)
+
+
+def _mkreq(i, L=10, T=20, **kw):
+    rng = np.random.default_rng(i)
+    from speakingstyle_tpu.serving.engine import SynthesisRequest
+
+    kw.setdefault(
+        "ref_mel", rng.standard_normal((T, 80)).astype(np.float32)
+    )
+    return SynthesisRequest(
+        id=f"utt{i}",
+        sequence=rng.integers(1, 300, L).astype(np.int32),
+        **kw,
+    )
+
+
+def _wav_bytes(seed=0, seconds=0.15, sr=22050):
+    """A small deterministic wav file as bytes (the upload body)."""
+    import scipy.io.wavfile
+
+    rng = np.random.default_rng(seed)
+    t = np.arange(int(sr * seconds)) / sr
+    wav = (0.3 * np.sin(2 * np.pi * (180 + 40 * seed) * t)
+           + 0.01 * rng.standard_normal(t.shape)).astype(np.float32)
+    buf = io.BytesIO()
+    scipy.io.wavfile.write(buf, sr, (wav * 32000).astype(np.int16))
+    return buf.getvalue()
+
+
+def test_style_precompiled_with_program_cards(tiny_style_engine):
+    style = tiny_style_engine.style
+    assert style.is_ready
+    assert style.compile_count == len(style.lattice) == 2  # [1,2] x [32]
+    cards = style.programs()
+    assert len(cards) == 2
+    for c in cards:
+        assert c["name"].startswith("style:")
+        assert c["flops"] > 0
+        json.dumps(c)
+    # per-ref-bucket FLOPs gauges published into the shared registry
+    text = tiny_style_engine.registry.prometheus_text()
+    assert 'serve_program_flops{bucket="b1.r32",kind="style"}' in text
+
+
+def test_cached_vs_fresh_embedding_bit_parity(tiny_style_engine):
+    """The same reference encodes to bit-identical vectors whether it
+    comes back from the cache or (hypothetically) fresh — the cache
+    stores exactly what the encoder produced."""
+    style = tiny_style_engine.style
+    ref = _ref(1)
+    first = style.encode_mel(ref)
+    d0 = style.dispatch_count
+    again = style.encode_mel(ref)
+    assert style.dispatch_count == d0          # pure cache hit
+    assert np.array_equal(first.gamma, again.gamma)
+    assert np.array_equal(first.beta, again.beta)
+    assert first.key == again.key == style.digest_mel(ref)
+
+
+def test_synthesis_from_cached_style_bit_identical(tiny_style_engine):
+    """Acceptance: synthesis driven by cached (gamma, beta) equals the
+    ref_mel-carrying path bit for bit — same vectors, same compiled
+    acoustic program, same audio."""
+    engine = tiny_style_engine
+    req = _mkreq(7)
+    r_ref = engine.run([req])[0]
+    cached = engine.style.encode_mel(req.ref_mel)   # cache hit
+    r_cached = engine.run([_mkreq(7, style=cached, ref_mel=None)])[0]
+    assert r_cached.mel_len == r_ref.mel_len
+    np.testing.assert_array_equal(r_cached.mel, r_ref.mel)
+    np.testing.assert_array_equal(r_cached.wav, r_ref.wav)
+    np.testing.assert_array_equal(r_cached.durations, r_ref.durations)
+
+
+def test_cached_request_zero_encoder_dispatches_zero_compiles(
+    tiny_style_engine,
+):
+    """Acceptance: a cached-style request performs ZERO reference-encoder
+    dispatches (hits counter moves, dispatch counter does not) and ZERO
+    XLA compiles, measured on the backend monitoring bus."""
+    from speakingstyle_tpu.serving.engine import CompileMonitor
+
+    engine = tiny_style_engine
+    style = engine.style
+    # warm: one dispatch per batch bucket with FRESH references so both
+    # encode batch shapes and both synthesis buckets have executed
+    for b in engine.lattice.batch_buckets:
+        engine.run([_mkreq(300 + b * 10 + j) for j in range(b)])
+    req = _mkreq(42)
+    engine.run([req])                    # encodes + caches this reference
+    hits0 = int(style._hits.value)
+    d0 = style.dispatch_count
+    c0 = engine.compile_count + style.compile_count
+    with CompileMonitor() as mon:
+        # same reference again (ref_mel path -> cache) and an explicit
+        # cached-vectors request: neither may touch the encoder
+        engine.run([_mkreq(42)])
+        cached = style.get(style.digest_mel(req.ref_mel))
+        assert cached is not None
+        engine.run([_mkreq(43, style=cached, ref_mel=None)])
+    assert mon.count == 0, "the style path compiled in steady state"
+    assert style.dispatch_count == d0, "cached style ran the encoder"
+    assert int(style._hits.value) > hits0
+    assert engine.compile_count + style.compile_count == c0
+
+
+def test_fresh_styles_batch_encode_and_dedup(tiny_style_engine):
+    """A coalesced dispatch with N fresh references runs ONE encoder
+    dispatch; duplicates within the batch encode once."""
+    engine = tiny_style_engine
+    style = engine.style
+    d0 = style.dispatch_count
+    ref = _ref(77)
+    reqs = [
+        _mkreq(500, ref_mel=None, style=None),
+        _mkreq(501, ref_mel=None, style=None),
+    ]
+    reqs[0].ref_mel = ref
+    reqs[1].ref_mel = ref.copy()          # same content, distinct array
+    engine.run(reqs)
+    assert style.dispatch_count == d0 + 1  # one padded encode, one row
+
+
+def test_cache_eviction_and_counters(tiny_style_engine):
+    """Bounded LRU: capacity-2 service evicts oldest, counts evictions,
+    and keeps hit/miss accounting exact."""
+    from speakingstyle_tpu.serving.style import StyleService
+
+    cfg = _tiny_cfg(cache_capacity=2)
+    svc = StyleService(cfg, tiny_style_engine.variables)
+    a, b, c = _ref(201), _ref(202), _ref(203)
+    svc.encode_mel(a)
+    svc.encode_mel(b)
+    assert len(svc) == 2
+    assert int(svc._misses.value) == 2 and int(svc._evictions.value) == 0
+    svc.encode_mel(a)                       # refresh a's LRU position
+    assert int(svc._hits.value) == 1
+    svc.encode_mel(c)                       # evicts b (least recent)
+    assert len(svc) == 2
+    assert int(svc._evictions.value) == 1
+    assert svc.get(svc.digest_mel(b)) is None
+    assert svc.get(svc.digest_mel(a)) is not None
+    # registration metadata for GET /styles
+    ids = [e["style_id"] for e in svc.styles()]
+    assert svc.digest_mel(c) in ids and len(ids) == 2
+
+
+def test_digest_is_content_addressed():
+    from speakingstyle_tpu.serving.style import StyleService
+
+    data = _wav_bytes(1)
+    assert StyleService.digest_bytes(data) == StyleService.digest_bytes(
+        bytes(data)
+    )
+    assert StyleService.digest_bytes(data) != StyleService.digest_bytes(
+        data + b"\x00"
+    )
+    mel = _ref(5)
+    assert StyleService.digest_mel(mel) == StyleService.digest_mel(mel.copy())
+    assert StyleService.digest_mel(mel) != StyleService.digest_mel(mel.T)
+
+
+def test_admit_validates_reference_against_style_lattice(tiny_style_engine):
+    engine = tiny_style_engine
+    with pytest.raises(RequestTooLarge, match="serve.style.ref_buckets"):
+        engine.admit(_mkreq(0, T=40))        # ref bucket max 32
+    with pytest.raises(ValueError, match="style"):
+        engine.admit(_mkreq(0, ref_mel=None))
+    # a cached-style request admits with no reference at all
+    sv = engine.style.encode_mel(_ref(9))
+    engine.admit(_mkreq(1, ref_mel=None, style=sv))
+
+
+def test_required_mel_ignores_reference_length(tiny_style_engine):
+    engine = tiny_style_engine
+    short = _mkreq(0, L=10, T=8)
+    long_ref = _mkreq(1, L=10, T=32)
+    assert engine.required_mel(short) == engine.required_mel(long_ref) == 20
+
+
+# ---------------------------------------------------------------------------
+# path confinement
+# ---------------------------------------------------------------------------
+
+
+def test_confined_ref_path_rejects_escapes(tmp_path):
+    from speakingstyle_tpu.serving.server import confined_ref_path
+
+    ref_dir = tmp_path / "refs"
+    ref_dir.mkdir()
+    (ref_dir / "ok.wav").write_bytes(_wav_bytes(3))
+    (tmp_path / "secret.wav").write_bytes(b"outside")
+    cfg = _tiny_cfg(ref_dir=str(ref_dir))
+    assert confined_ref_path(cfg, "ok.wav") == str(ref_dir / "ok.wav")
+    for bad in ("../secret.wav", "a/../../secret.wav",
+                str(tmp_path / "secret.wav"), "/etc/passwd"):
+        with pytest.raises(ValueError, match="escapes|disabled"):
+            confined_ref_path(cfg, bad)
+    with pytest.raises(ValueError, match="does not exist"):
+        confined_ref_path(cfg, "missing.wav")
+    # unset ref_dir disables server-side paths entirely
+    with pytest.raises(ValueError, match="disabled"):
+        confined_ref_path(_tiny_cfg(), "ok.wav")
+
+
+# ---------------------------------------------------------------------------
+# speaker registry validation
+# ---------------------------------------------------------------------------
+
+
+def test_frontend_speaker_registry_validation(tiny_style_engine):
+    from speakingstyle_tpu.serving.server import TextFrontend
+
+    fe = TextFrontend(tiny_style_engine.cfg, _ref(0),
+                      style=tiny_style_engine.style)
+    fe.speaker_map = {"mary": 0, "john": 1}
+    assert fe.speaker("mary") == 0 and fe.speaker(1) == 1
+    with pytest.raises(ValueError, match="unknown speaker"):
+        fe.speaker("ghost")
+    with pytest.raises(ValueError, match="outside the registry"):
+        fe.speaker(7)
+
+    # a style bound to a speaker drives that speaker by default and
+    # refuses a conflicting explicit one
+    bound = tiny_style_engine.style.encode_mel(_ref(31), speaker="john")
+    req = fe.request("r1", {"text": "hi", "style_id": bound.key})
+    assert req.speaker == 1 and req.style is bound
+    with pytest.raises(ValueError, match="bound to speaker"):
+        fe.request("r2", {"text": "hi", "style_id": bound.key,
+                          "speaker_id": "mary"})
+
+
+def test_per_word_controls_in_request_schema(tiny_style_engine):
+    """The documented /synthesize schema accepts per-WORD control lists:
+    expanded to per-phoneme arrays through the span-preserving G2P, and
+    a wrong word count is a 400-shaped ValueError."""
+    from speakingstyle_tpu.serving.server import TextFrontend
+
+    fe = TextFrontend(tiny_style_engine.cfg, _ref(0),
+                      style=tiny_style_engine.style)
+    req = fe.request("r1", {
+        "text": "hi there", "duration_control": [2.0, 1.0],
+        "pitch_control": 1.2,
+    })
+    assert isinstance(req.d_control, np.ndarray)
+    assert req.d_control.shape == req.sequence.shape
+    assert req.p_control == 1.2
+    # the expanded request runs through the engine like any other
+    result = tiny_style_engine.run([req])[0]
+    assert result.mel_len > 0
+    with pytest.raises(ValueError, match="per word"):
+        fe.request("r2", {"text": "hi there",
+                          "duration_control": [1.0, 2.0, 3.0]})
+    with pytest.raises(ValueError, match="number"):
+        fe.request("r3", {"text": "hi", "pitch_control": "fast"})
+
+
+# ---------------------------------------------------------------------------
+# HTTP roundtrip
+# ---------------------------------------------------------------------------
+
+
+def test_http_styles_roundtrip(tiny_style_engine, tmp_path):
+    """POST /styles (wav upload) -> style_id -> /synthesize with it;
+    GET /styles lists the entry; re-upload is an idempotent cache hit;
+    a ref_dir-confined JSON registration works and `..` escapes 400."""
+    from speakingstyle_tpu.serving.server import SynthesisServer, TextFrontend
+
+    ref_dir = tmp_path / "refs"
+    ref_dir.mkdir()
+    (ref_dir / "house.wav").write_bytes(_wav_bytes(9))
+    cfg = _tiny_cfg(ref_dir=str(ref_dir))
+    server = SynthesisServer(
+        tiny_style_engine,
+        TextFrontend(cfg, None, style=tiny_style_engine.style),
+        host="127.0.0.1", port=0,
+    )
+    host, port = server.address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        wav = _wav_bytes(11)
+        conn.request("POST", "/styles", body=wav,
+                     headers={"Content-Type": "audio/wav"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        assert resp.status == 200, out
+        style_id = out["style_id"]
+        assert style_id and out["cached"] is False
+        assert out["ref_frames"] > 0
+
+        # idempotent: same bytes -> same id, zero encoder work
+        d0 = tiny_style_engine.style.dispatch_count
+        conn.request("POST", "/styles", body=wav,
+                     headers={"Content-Type": "audio/wav"})
+        again = json.loads(conn.getresponse().read())
+        assert again["style_id"] == style_id and again["cached"] is True
+        assert tiny_style_engine.style.dispatch_count == d0
+
+        conn.request("GET", "/styles")
+        listing = json.loads(conn.getresponse().read())
+        assert style_id in [e["style_id"] for e in listing["styles"]]
+        assert listing["capacity"] == cfg.serve.style.cache_capacity
+
+        # synthesize with the registered style — zero encoder dispatches
+        conn.request("POST", "/synthesize", body=json.dumps(
+            {"text": "hi", "style_id": style_id}
+        ))
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 200, body
+        assert body[:4] == b"RIFF"
+        assert tiny_style_engine.style.dispatch_count == d0
+
+        # unknown style_id -> 400
+        conn.request("POST", "/synthesize", body=json.dumps(
+            {"text": "hi", "style_id": "f" * 64}
+        ))
+        resp = conn.getresponse()
+        assert resp.status == 400 and b"unknown style_id" in resp.read()
+
+        # JSON registration from the confined ref_dir
+        conn.request("POST", "/styles", body=json.dumps(
+            {"ref_audio": "house.wav"}
+        ), headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        reg = json.loads(resp.read())
+        assert resp.status == 200, reg
+
+        # `..` escape -> 400 (the security satellite)
+        conn.request("POST", "/synthesize", body=json.dumps(
+            {"text": "hi", "ref_audio": "../../etc/passwd"}
+        ))
+        resp = conn.getresponse()
+        assert resp.status == 400 and b"escapes" in resp.read()
+
+        # /healthz surfaces the style accounting
+        conn.request("GET", "/healthz")
+        health = json.loads(conn.getresponse().read())
+        assert health["style"]["entries"] >= 2
+        assert health["style"]["hits"] >= 1
+        conn.close()
+    finally:
+        server.shutdown()
+
+
+def test_http_e2e_zero_compiles_with_style_path(tiny_style_engine):
+    """The full acceptance loop over HTTP: after warmup, serving cached
+    styles (style_id) AND fresh uploads performs ZERO XLA compiles."""
+    from speakingstyle_tpu.serving.engine import CompileMonitor
+    from speakingstyle_tpu.serving.server import SynthesisServer, TextFrontend
+
+    engine = tiny_style_engine
+    server = SynthesisServer(
+        engine, TextFrontend(engine.cfg, _ref(90), style=engine.style),
+        host="127.0.0.1", port=0,
+    )
+    host, port = server.address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        # warmup: default-ref request (encodes once) + an upload
+        conn.request("POST", "/synthesize", body=json.dumps({"text": "hi"}))
+        resp = conn.getresponse()
+        resp.read()
+        assert resp.status == 200
+        conn.request("POST", "/styles", body=_wav_bytes(91),
+                     headers={"Content-Type": "audio/wav"})
+        style_id = json.loads(conn.getresponse().read())["style_id"]
+        with CompileMonitor() as mon:
+            for _ in range(3):
+                conn.request("POST", "/synthesize", body=json.dumps(
+                    {"text": "hello there", "style_id": style_id}
+                ))
+                resp = conn.getresponse()
+                resp.read()
+                assert resp.status == 200
+        assert mon.count == 0, "HTTP style serving compiled in steady state"
+        conn.close()
+    finally:
+        server.shutdown()
