@@ -1214,6 +1214,262 @@ def run_fleet(duration: float = 3.0, replica_counts=(1, 2, 4),
     return scaling
 
 
+def run_chaos(duration: float = 3.0, clients: int = 16,
+              device_ms: float = 20.0):
+    """Chaos drill: kill one of two replicas at a deterministic dispatch
+    count under steady load and measure what supervision costs.
+
+    Three phases over the same fleet (the run_fleet CPU-proxy setup):
+    prefault steady load, a chaos phase that arms ``replica_raise`` on
+    the next dispatch (quiesced between phases so the armed counter
+    cannot be raced past), and a postfault steady phase once both
+    replicas are READY again. A monitor thread polls replica states to
+    timestamp the failure and the recovery. Closed-loop clients await
+    every request they submit, so the lost-request count is exact:
+    anything that neither returned a result nor was intentionally shed
+    (Overloaded) counts as lost — the drill's invariant is that this is
+    ZERO. CompileMonitor spans the prefault and postfault phases (the
+    re-warm recompile between them is the one legitimate compile window).
+    """
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+
+    from speakingstyle_tpu.configs.config import FleetConfig
+    from speakingstyle_tpu.faults import FaultPlan
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.models.hifigan import Generator
+    from speakingstyle_tpu.obs import MetricsRegistry
+    from speakingstyle_tpu.serving.batcher import Overloaded
+    from speakingstyle_tpu.serving.engine import (
+        CompileMonitor,
+        SynthesisEngine,
+        SynthesisRequest,
+    )
+    from speakingstyle_tpu.serving.fleet import FAILED, READY, FleetRouter
+    from speakingstyle_tpu.serving.style import StyleService
+
+    on_tpu = _is_tpu(jax.devices()[0])
+    if on_tpu:
+        device_ms = 0.0
+    label = "tiny-cpu-proxydev" if device_ms > 0 else (
+        "flagship" if on_tpu else "tiny-cpu"
+    )
+    _mark("building chaos fleet parts")
+    cfg = _fleet_proxy_config()
+    # generous deadline budgets: the drill measures supervision (requeue
+    # + re-warm), so scheduling-induced expiry must not masquerade as
+    # loss; a short re-warm backoff keeps the recovery window tight
+    cfg = dataclasses.replace(cfg, serve=dataclasses.replace(
+        cfg.serve, fleet=FleetConfig(
+            stream_window=8, queue_depth=256,
+            class_deadline_ms={"interactive": 30_000.0, "batch": 60_000.0},
+            rewarm_backoff_s=0.2, rewarm_backoff_max_s=5.0,
+        ),
+    ))
+    serve = cfg.serve
+    n_position = max(serve.mel_buckets[-1], serve.src_buckets[-1],
+                     cfg.model.max_seq_len) + 1
+    model = build_model(cfg, n_position=n_position)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    gen = Generator(
+        upsample_rates=(2, 2), upsample_kernel_sizes=(4, 4),
+        upsample_initial_channel=16, resblock_kernel_sizes=(3,),
+        resblock_dilation_sizes=((1,),),
+    )
+    n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+    gparams = gen.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8, n_mels), np.float32)
+    )["params"]
+    rng = np.random.default_rng(0)
+    max_len = min(serve.src_buckets[-1],
+                  serve.mel_buckets[-1] // serve.frames_per_phoneme)
+    max_ref = serve.style.ref_buckets[-1]
+    hot_refs = [
+        rng.standard_normal(
+            (int(rng.integers(8, max_ref + 1)), n_mels)
+        ).astype(np.float32)
+        for _ in range(8)
+    ]
+
+    def make_request(i: int, priority: str) -> SynthesisRequest:
+        L = int(rng.integers(max(4, max_len // 2), max_len + 1))
+        return SynthesisRequest(
+            id=f"chaos{i}",
+            sequence=rng.integers(1, 300, L).astype(np.int32),
+            ref_mel=hot_refs[i % len(hot_refs)],
+            priority=priority,
+        )
+
+    registry = MetricsRegistry()
+    plan = FaultPlan()
+    shared_style = StyleService(cfg, variables, registry=registry)
+
+    def factory(reg):
+        return ProxyDeviceEngine(
+            SynthesisEngine(
+                cfg, variables, vocoder=(gen, gparams), model=model,
+                registry=reg, style=shared_style,
+            ),
+            device_ms,
+        )
+
+    _mark("warming 2 chaos replicas")
+    router = FleetRouter(factory, cfg, replicas=2, registry=registry,
+                         style=shared_style, fault_plan=plan)
+    if not router.wait_ready(timeout=600, n=2):
+        print(json.dumps({
+            "metric": "serve_chaos", "replicas": 2,
+            "error": "replicas never became ready", "model": label,
+        }))
+        router.close()
+        return None
+
+    def transfer_warmup(base: int):
+        for engine in router.engines():
+            for b in engine.lattice.batch_buckets:
+                engine.run([make_request(base + b * 100 + j, "batch")
+                            for j in range(b)])
+
+    transfer_warmup(10_000_000)
+
+    def load_phase(phase_s: float, seed: int):
+        """Closed-loop load; every submitted request is awaited. Returns
+        {ok, shed, lost, errors, qps}."""
+        stop_at = time.perf_counter() + phase_s
+        per = [dict(ok=0, shed=0, lost=0, errors=[])
+               for _ in range(clients)]
+
+        def client(cid: int):
+            c, i = per[cid], 0
+            while time.perf_counter() < stop_at:
+                prio = "interactive" if (cid + i) % 2 == 0 else "batch"
+                req = make_request(seed + cid * 1_000_000 + i, prio)
+                try:
+                    router.submit(req).result(timeout=120)
+                    c["ok"] += 1
+                except Overloaded:
+                    c["shed"] += 1
+                    time.sleep(0.002)
+                except Exception as e:  # structured failure OR stuck: lost
+                    c["lost"] += 1
+                    c["errors"].append(type(e).__name__)
+                i += 1
+
+        threads = [threading.Thread(target=client, args=(c,), daemon=True)
+                   for c in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        out = {k: sum(c[k] for c in per) for k in ("ok", "shed", "lost")}
+        out["errors"] = sorted({e for c in per for e in c["errors"]})
+        out["qps"] = out["ok"] / dt
+        return out
+
+    _mark("chaos phase A: prefault steady load")
+    with CompileMonitor() as pre_mon:
+        prefault = load_phase(duration, 0)
+
+    # quiesced between phases: dispatch_total is stable, so the armed
+    # counter value deterministically hits the NEXT dispatch
+    plan.arm("replica_raise", router.dispatch_total + 1)
+    timeline = {}
+    stop_mon = threading.Event()
+
+    def monitor():
+        while not stop_mon.is_set():
+            states = list(router.states().values())
+            now = time.perf_counter()
+            if FAILED in states and "t_failed" not in timeline:
+                timeline["t_failed"] = now
+            if ("t_failed" in timeline and "t_recovered" not in timeline
+                    and all(s == READY for s in states)):
+                timeline["t_recovered"] = now
+                return
+            time.sleep(0.002)
+
+    mon_thread = threading.Thread(target=monitor, daemon=True)
+    mon_thread.start()
+    _mark("chaos phase B: replica kill under load")
+    chaos = load_phase(duration, 100_000_000)
+    # the re-warm (a fresh engine precompiling the full lattice) may
+    # outlast the load phase; wait it out before the postfault measure
+    deadline = time.monotonic() + 600
+    while time.monotonic() < deadline and "t_recovered" not in timeline:
+        time.sleep(0.05)
+    stop_mon.set()
+    mon_thread.join(timeout=5)
+    recovered = "t_recovered" in timeline
+    recovery_ms = (
+        round(1e3 * (timeline["t_recovered"] - timeline["t_failed"]), 1)
+        if recovered and "t_failed" in timeline else None
+    )
+    postfault = None
+    post_compiles = None
+    if recovered:
+        transfer_warmup(20_000_000)  # the re-warmed engine's first runs
+        _mark("chaos phase C: postfault steady load")
+        with CompileMonitor() as post_mon:
+            postfault = load_phase(duration, 200_000_000)
+        post_compiles = post_mon.count
+    router.close()
+
+    failures = sum(
+        int(registry.value("serve_replica_failures_total",
+                           {"replica": str(i)}))
+        for i in range(2)
+    )
+    lost = chaos["lost"] + prefault["lost"] + (
+        postfault["lost"] if postfault else 0
+    )
+    ratio = (
+        round(postfault["qps"] / prefault["qps"], 3)
+        if postfault and prefault["qps"] else None
+    )
+    point = {
+        "metric": "serve_chaos",
+        "replicas": 2,
+        "clients": clients,
+        "prefault_qps": round(prefault["qps"], 2),
+        "chaos_qps": round(chaos["qps"], 2),
+        "postfault_qps": round(postfault["qps"], 2) if postfault else None,
+        "qps_recovery_ratio": ratio,
+        "recovery_ms": recovery_ms,
+        "lost_requests": lost,
+        "shed": prefault["shed"] + chaos["shed"] + (
+            postfault["shed"] if postfault else 0
+        ),
+        "errors": sorted(set(
+            prefault["errors"] + chaos["errors"]
+            + (postfault["errors"] if postfault else [])
+        )),
+        "replica_failures": failures,
+        "requeued": int(registry.value("serve_requeued_total")),
+        "retries": int(registry.value("serve_retries_total",
+                                      {"class": "interactive"})
+                       + registry.value("serve_retries_total",
+                                        {"class": "batch"})),
+        "deadline_exceeded": int(
+            registry.value("serve_deadline_exceeded_total",
+                           {"class": "interactive"})
+            + registry.value("serve_deadline_exceeded_total",
+                             {"class": "batch"})
+        ),
+        "compiles_prefault": pre_mon.count,
+        "compiles_postfault": post_compiles,
+        "recovered": recovered,
+        "proxy_device_ms": device_ms,
+        "model": label,
+    }
+    print(json.dumps(point))
+    return point
+
+
 def run_ab():
     """A/B the performance knobs (README "Performance knobs"): one process
     per variant so each gets a clean backend; prints one JSON line each."""
@@ -1286,6 +1542,21 @@ def _absorb_record(rec, metrics):
                     "full_p95_ms"):
             if isinstance(rec.get(pct), (int, float)):
                 metrics[f"fleet_{pct}_{r}r"] = (float(rec[pct]), "lower")
+    elif m == "serve_chaos":
+        # the drill's SLO numbers ride the regression gate like any other
+        # metric; lost_requests additionally carries a hard zero gate in
+        # run_compare (any loss fails the diff outright)
+        if isinstance(rec.get("recovery_ms"), (int, float)):
+            metrics["chaos_recovery_ms"] = (float(rec["recovery_ms"]),
+                                            "lower")
+        if isinstance(rec.get("qps_recovery_ratio"), (int, float)):
+            metrics["chaos_qps_recovery_ratio"] = (
+                float(rec["qps_recovery_ratio"]), "higher")
+        if isinstance(rec.get("lost_requests"), (int, float)):
+            metrics["chaos_lost_requests"] = (float(rec["lost_requests"]),
+                                              "lower")
+        if isinstance(rec.get("shed"), (int, float)):
+            metrics["chaos_shed"] = (float(rec["shed"]), "lower")
     elif m == "serve_style_cache_qps_gain":
         if isinstance(rec.get("value"), (int, float)):
             metrics[m] = (float(rec["value"]), "higher")
@@ -1362,6 +1633,15 @@ def run_compare(old_path, new_path=None, threshold=REGRESSION_THRESHOLD,
             return 2
     old = _artifact_metrics(old_path)
     new = _artifact_metrics(new_path)
+    # chaos hard gate, independent of the old artifact: the drill's
+    # lost-request count must be ZERO — a supervision bug that drops
+    # requests is not a 10%-threshold matter
+    lost = new.get("chaos_lost_requests")
+    if lost is not None and lost[0] > 0:
+        print(f"FAIL: chaos drill lost {int(lost[0])} request(s) in "
+              f"{os.path.basename(new_path)}; supervision must requeue "
+              "or structurally resolve every in-flight request", file=out)
+        return 1
     common = sorted(set(old) & set(new))
     if not common:
         print(f"no comparable metrics between {old_path} and {new_path} "
@@ -1481,6 +1761,11 @@ if __name__ == "__main__":
         run_serve(duration=dur)
         run_fleet(duration=dur)
         run_style(duration=dur)
+        run_chaos(duration=dur)
+    elif "--chaos" in sys.argv:
+        dur = (float(sys.argv[sys.argv.index("--duration") + 1])
+               if "--duration" in sys.argv else 3.0)
+        run_chaos(duration=dur)
     elif "--fleet" in sys.argv:
         dur = (float(sys.argv[sys.argv.index("--duration") + 1])
                if "--duration" in sys.argv else 3.0)

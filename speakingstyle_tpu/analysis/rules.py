@@ -39,6 +39,12 @@ JL012  unbounded caches in serving code: lru_cache(maxsize=None)/
        caching per-request content (styles, mels, ...) grows without
        bound under real traffic; use a bounded LRU with an eviction
        counter (serving/style.py) instead
+JL013  unbounded blocking waits in serving code: ``.result()`` or a
+       zero-argument ``.get()`` with no ``timeout=`` under
+       speakingstyle_tpu/serving/ — a handler or worker parked forever
+       on a future/queue survives the very replica failure the
+       supervision layer exists to detect; every serving wait needs a
+       deadline so a fault resolves as a structured 5xx, not a hang
 """
 
 import ast
@@ -1534,6 +1540,66 @@ def rule_jl012(mod: ModuleInfo) -> Iterator[Finding]:
                     )
 
 
+# ---------------------------------------------------------------------------
+# JL013 — unbounded blocking waits in serving code
+# ---------------------------------------------------------------------------
+
+
+def rule_jl013(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL013: a blocking wait with no timeout under
+    ``speakingstyle_tpu/serving/`` — ``fut.result()`` with no arguments,
+    or a zero-argument ``q.get()`` (the ``queue.Queue`` signature; a
+    ``dict.get(key)`` carries a positional argument and is not matched)
+    — neither carrying a ``timeout=``.
+
+    Serving threads that wait forever undo the resilience contract: the
+    supervisor can fail a replica, requeue its batch, and resolve every
+    future with a structured error, but a handler parked on a bare
+    ``future.result()`` (or a worker on a bare ``queue.get()``) only
+    benefits if *someone* resolves/feeds it — a bookkeeping bug or a
+    lost wakeup then hangs the connection with no 5xx ever sent. Every
+    wait in the serving tree must carry a deadline (the class deadline
+    budget + grace for request futures; a poll interval for queues) so
+    the worst case is a timely 504, not a stuck thread.
+    """
+    p = mod.path.replace("\\", "/")
+    if "speakingstyle_tpu/serving/" not in p:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            continue
+        if func.attr not in ("result", "get"):
+            continue
+        # zero positional args only: dict.get(key[, default]) and
+        # result(timeout) positionally both carry args and are bounded
+        # (or at least deliberate); the bare no-arg call is the hazard
+        if node.args:
+            continue
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            continue
+        fn = mod.enclosing_function(node)
+        qual = mod.qualname(fn or mod.tree)
+        recv = _dotted(func.value) or "<expr>"
+        yield Finding(
+            rule="JL013",
+            path=mod.path,
+            line=node.lineno,
+            context=qual,
+            detail=f"bare {recv}.{func.attr}() with no timeout",
+            message=(
+                f"`{recv}.{func.attr}()` in serving code ({qual}) blocks "
+                "forever: if the producer dies or a bookkeeping bug drops "
+                "the wakeup, this thread hangs with no 5xx ever sent. "
+                "Pass timeout= (request futures: the class deadline "
+                "budget + grace; queues: a poll interval) and map the "
+                "timeout to a structured error."
+            ),
+        )
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -1547,4 +1613,5 @@ RULES = {
     "JL010": rule_jl010,
     "JL011": rule_jl011,
     "JL012": rule_jl012,
+    "JL013": rule_jl013,
 }

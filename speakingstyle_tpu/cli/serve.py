@@ -115,7 +115,7 @@ def load_engine_parts(cfg, restore_step: int, vocoder_ckpt=None,
 
 
 def load_engine(cfg, restore_step: int, vocoder_ckpt=None, griffin_lim=False,
-                registry=None):
+                registry=None, fault_plan=None):
     """Restore the acoustic checkpoint + vocoder and build one engine.
 
     Shared by ``serve`` and ``synthesize`` so the CLI one-shot path and
@@ -128,7 +128,7 @@ def load_engine(cfg, restore_step: int, vocoder_ckpt=None, griffin_lim=False,
     )
     return SynthesisEngine(
         cfg, variables, vocoder=vocoder, lattice=lattice, model=model,
-        registry=registry,
+        registry=registry, fault_plan=fault_plan,
     )
 
 
@@ -140,6 +140,14 @@ def main(args):
     )
 
     cfg = config_from_args(args)
+    # ONE deterministic fault plan from SPEAKINGSTYLE_FAULTS, threaded to
+    # every serving component — a single shared plan keeps the @N counters
+    # exact (building a plan per component would double-fire each entry)
+    from speakingstyle_tpu.faults import FaultPlan
+
+    fault_plan = FaultPlan.from_env() or None
+    if fault_plan:
+        print(f"fault injection armed: {fault_plan.pending()}", flush=True)
     if getattr(args, "ref_dir", None):
         import dataclasses
 
@@ -190,7 +198,8 @@ def main(args):
         # one AOT encoder lattice (the first replica's warm-up compiles
         # it; the rest find it ready)
         style = (
-            StyleService(cfg, variables, registry=registry)
+            StyleService(cfg, variables, registry=registry,
+                         fault_plan=fault_plan)
             if cfg.model.use_reference_encoder else None
         )
 
@@ -198,11 +207,13 @@ def main(args):
             return SynthesisEngine(
                 cfg, variables, vocoder=vocoder, lattice=lattice,
                 model=model, registry=reg, style=style,
+                fault_plan=fault_plan,
             )
 
         router = FleetRouter(
             factory, cfg, replicas=replicas,
             registry=registry, events=events, style=style,
+            fault_plan=fault_plan,
         )
         print(
             f"warming {replicas} replicas x {len(router.lattice)} lattice "
@@ -220,6 +231,7 @@ def main(args):
         engine = load_engine(
             cfg, args.restore_step,
             vocoder_ckpt=args.vocoder_ckpt, griffin_lim=args.griffin_lim,
+            fault_plan=fault_plan,
         )
         has_style = engine.style is not None
         style_points = len(engine.style.lattice) if has_style else 0

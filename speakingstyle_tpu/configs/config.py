@@ -486,6 +486,27 @@ class FleetConfig:
     stream_overlap: int = 0
     # SIGTERM/shutdown waits this long for in-flight streams to finish
     drain_timeout_s: float = 10.0
+    # --- resilience (serving/resilience.py, ARCHITECTURE.md "Serving
+    # resilience") ---
+    # a READY replica whose dispatch has been on-device longer than this
+    # is declared hung: the supervisor fails it, requeues its in-flight
+    # requests and re-warms it; 0 disables the watchdog
+    hang_watchdog_s: float = 10.0
+    # per-class retry budget for transient replica failures: a request
+    # requeued off a failed replica is retried at most this many times
+    # before resolving as ReplicaError (503); classes absent from the
+    # map get no retries — streams continuations are never retried
+    retry_budget: Dict[str, int] = field(
+        default_factory=lambda: {"interactive": 1, "batch": 2}
+    )
+    # circuit-breaker re-warm backoff: first re-warm after this many
+    # seconds, doubling per consecutive failure, capped at the max
+    rewarm_backoff_s: float = 0.5
+    rewarm_backoff_max_s: float = 30.0
+    # grace added on top of the class deadline budget when the HTTP
+    # layer bounds future.result(timeout=...) — the deadline is enforced
+    # in the router; the grace covers result readback + response writing
+    deadline_grace_ms: float = 500.0
 
     def __post_init__(self):
         if self.replicas < 1:
@@ -522,6 +543,30 @@ class FleetConfig:
         if self.drain_timeout_s < 0:
             raise ValueError(
                 f"fleet.drain_timeout_s must be >= 0, got {self.drain_timeout_s}"
+            )
+        if self.hang_watchdog_s < 0:
+            raise ValueError(
+                f"fleet.hang_watchdog_s must be >= 0 (0 disables), got "
+                f"{self.hang_watchdog_s}"
+            )
+        for name, n in self.retry_budget.items():
+            if n < 0:
+                raise ValueError(
+                    f"fleet.retry_budget[{name!r}] must be >= 0, got {n}"
+                )
+        if self.rewarm_backoff_s <= 0:
+            raise ValueError(
+                f"fleet.rewarm_backoff_s must be > 0, got {self.rewarm_backoff_s}"
+            )
+        if self.rewarm_backoff_max_s < self.rewarm_backoff_s:
+            raise ValueError(
+                "fleet.rewarm_backoff_max_s must be >= rewarm_backoff_s, got "
+                f"{self.rewarm_backoff_max_s} < {self.rewarm_backoff_s}"
+            )
+        if self.deadline_grace_ms < 0:
+            raise ValueError(
+                f"fleet.deadline_grace_ms must be >= 0, got "
+                f"{self.deadline_grace_ms}"
             )
 
 

@@ -1,0 +1,129 @@
+"""Deterministic fault injection, shared by the training and serving stacks.
+
+Every recovery path in the tree — training/resilience.py's rollback and
+checkpoint drills AND serving/resilience.py's replica supervision — is
+exercised end-to-end by injecting the fault it guards against at an
+exact, named point.  The ``SPEAKINGSTYLE_FAULTS`` environment variable
+holds a spec like
+
+    loader_ioerror@7;nan_grads@12;sigterm@20
+    replica_raise@40;style_encode_error@2
+
+meaning each named site's counter tripping the named value fires the
+fault once.  Each entry fires exactly once — a retried load, a replayed
+step after rollback, or a requeued request does NOT re-trip the same
+entry, which is what makes recovery observable.  Duplicate entries are
+allowed (``nan_grads@3;nan_grads@3`` poisons the replay too — how the
+consecutive-rollback abort is tested).
+
+Counter semantics per kind:
+
+  training (consumed via training/faults.py, which re-exports this plan):
+
+  ``loader_ioerror@N``  Nth call of ``SpeechDataset._feature`` (1-based,
+                        counted per dataset instance)
+  ``nan_grads@N``       the batch consumed by the train step whose
+                        post-increment step counter is N
+  ``sigterm@N``         delivered after step N completes
+
+  serving (serving/resilience.py; the chaos drills):
+
+  ``replica_raise@N``       the fleet router's Nth coalesced dispatch
+                            (router-global, 1-based) raises InjectedFault
+                            before touching the replica engine
+  ``replica_hang@N``        same counter; the dispatch stalls past the
+                            hang watchdog instead of raising
+  ``style_encode_error@N``  the StyleService's Nth reference-encoder
+                            dispatch attempt raises before device work
+  ``vocoder_raise@N``       the engine's Nth ``vocode_window`` call
+                            (per engine instance) raises — a streaming
+                            continuation fault
+
+The plan is plain Python state constructed per run (``FaultPlan.from_env``)
+and threaded explicitly into the sites — no module globals, so tests can
+run many faulted loops in one process.  ``fire`` is thread-safe (serving
+sites race from replica workers) and ``arm`` appends entries to a live
+plan, which is how ``bench.py --chaos`` kills a replica mid-load at a
+deterministic dispatch count.
+"""
+
+import dataclasses
+import os
+import threading
+from typing import List, Sequence, Tuple
+
+ENV_VAR = "SPEAKINGSTYLE_FAULTS"
+
+TRAINING_KINDS = ("loader_ioerror", "nan_grads", "sigterm")
+SERVING_KINDS = (
+    "replica_raise", "replica_hang", "style_encode_error", "vocoder_raise",
+)
+KINDS = TRAINING_KINDS + SERVING_KINDS
+
+
+@dataclasses.dataclass
+class _Fault:
+    kind: str
+    at: int
+    fired: bool = False
+
+
+class FaultPlan:
+    """A parsed fault spec; each entry fires at most once."""
+
+    def __init__(self, faults: Sequence[_Fault] = ()):
+        self._faults: List[_Fault] = list(faults)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        faults = []
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            kind, sep, at = part.partition("@")
+            kind = kind.strip()
+            if not sep or kind not in KINDS:
+                raise ValueError(
+                    f"bad fault spec entry {part!r}: expected <kind>@<step> "
+                    f"with kind in {KINDS}"
+                )
+            try:
+                step = int(at)  # jaxlint: disable=JL004
+            except ValueError:
+                raise ValueError(
+                    f"bad fault spec entry {part!r}: step {at!r} is not an int"
+                ) from None
+            faults.append(_Fault(kind, step))
+        return cls(faults)
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan":
+        return cls.parse(os.environ.get(ENV_VAR, ""))
+
+    def __bool__(self) -> bool:
+        return bool(self._faults)
+
+    def arm(self, kind: str, at: int) -> None:
+        """Append one entry to a live plan (bench.py --chaos arms the
+        replica kill between load phases, at a dispatch count that has
+        not happened yet)."""
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; kinds: {KINDS}")
+        with self._lock:
+            self._faults.append(_Fault(kind, int(at)))
+
+    def fire(self, kind: str, at: int) -> bool:
+        """True exactly once per matching entry when the site's counter
+        hits the named value; False forever after."""
+        with self._lock:
+            for f in self._faults:
+                if f.kind == kind and f.at == at and not f.fired:
+                    f.fired = True
+                    return True
+        return False
+
+    def pending(self) -> List[Tuple[str, int]]:
+        with self._lock:
+            return [(f.kind, f.at) for f in self._faults if not f.fired]

@@ -37,6 +37,7 @@ from speakingstyle_tpu.serving.engine import (
     SynthesisRequest,
     bucket_label,
 )
+from speakingstyle_tpu.serving.resilience import DispatchError
 
 
 class ShutdownError(RuntimeError):
@@ -255,29 +256,53 @@ class ContinuousBatcher:
                 p.future.set_exception(e)
             return
         now = time.monotonic()
-        self._batches.inc()
-        self.registry.counter(
-            "serve_batch_occupancy_total", labels={"rows": str(len(batch))},
-            help="dispatches by real-row occupancy",
-        ).inc()
-        bucket = getattr(results[0], "bucket", None) if results else None
-        if bucket is not None:
+        try:
+            self._batches.inc()
             self.registry.counter(
-                "serve_bucket_dispatch_total",
-                labels={"bucket": bucket_label(bucket)},
-                help="dispatches by covering lattice bucket",
+                "serve_batch_occupancy_total",
+                labels={"rows": str(len(batch))},
+                help="dispatches by real-row occupancy",
             ).inc()
-        if self.events is not None:
-            # the req_ids make this record joinable with the server's
-            # per-request http_request events (satellite: end-to-end ids)
-            self.events.emit(
-                "serve_dispatch", req_ids=req_ids, rows=len(batch),
-                bucket=bucket_label(bucket) if bucket is not None else None,
-                duration_s=now - t0,
+            bucket = getattr(results[0], "bucket", None) if results else None
+            if bucket is not None:
+                self.registry.counter(
+                    "serve_bucket_dispatch_total",
+                    labels={"bucket": bucket_label(bucket)},
+                    help="dispatches by covering lattice bucket",
+                ).inc()
+            if self.events is not None:
+                # the req_ids make this record joinable with the server's
+                # per-request http_request events (satellite: end-to-end ids)
+                self.events.emit(
+                    "serve_dispatch", req_ids=req_ids, rows=len(batch),
+                    bucket=(bucket_label(bucket) if bucket is not None
+                            else None),
+                    duration_s=now - t0,
+                )
+            for p, r in zip(batch, results):
+                self._latency_hist.observe(now - p.request.arrival)
+                p.future.set_result(r)
+        except BaseException as e:
+            # bookkeeping bug after a successful engine call: resolve the
+            # affected futures with a structured error so the dispatch
+            # thread survives — a raise here used to kill it and strand
+            # every request queued behind this batch
+            self.registry.counter(
+                "serve_dispatch_errors_total",
+                help="dispatch-loop bookkeeping errors resolved as "
+                     "DispatchError (500) without killing the worker",
+            ).inc()
+            err = DispatchError(
+                f"dispatch bookkeeping failed: {type(e).__name__}: {e}"
             )
-        for p, r in zip(batch, results):
-            self._latency_hist.observe(now - p.request.arrival)
-            p.future.set_result(r)
+            for p in batch:
+                if not p.future.done():
+                    p.future.set_exception(err)
+            if self.events is not None:
+                self.events.emit(
+                    "dispatch_error", req_ids=req_ids,
+                    error=type(e).__name__,
+                )
 
     def _worker(self) -> None:
         try:
@@ -288,9 +313,10 @@ class ContinuousBatcher:
                     self._dispatch(batch)
                 if terminal:
                     return
-        except BaseException as e:  # engine errors are caught per-batch;
-            # anything here is a harness bug — fail every waiter loudly
-            # rather than stranding them, then re-raise for visibility
+        except BaseException as e:  # engine + bookkeeping errors are
+            # caught per-batch inside _dispatch; anything here is a
+            # harness bug — fail every waiter loudly rather than
+            # stranding them, then re-raise for visibility
             self._fail_pending(e)
             raise
 

@@ -56,6 +56,7 @@ from typing import Dict, List, Optional, Tuple, Union
 import numpy as np
 
 from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.faults import FaultPlan
 from speakingstyle_tpu.obs import CompileMonitor, MetricsRegistry, watch_compiles
 from speakingstyle_tpu.obs.cost import (
     FLOPS_PER_SEC_BUCKETS,
@@ -63,6 +64,7 @@ from speakingstyle_tpu.obs.cost import (
     publish_program_gauges,
 )
 from speakingstyle_tpu.serving.lattice import Bucket, BucketLattice, RequestTooLarge
+from speakingstyle_tpu.serving.resilience import InjectedFault
 from speakingstyle_tpu.serving.style import StyleService, StyleVectors
 from speakingstyle_tpu.training.resilience import retry_io
 
@@ -108,6 +110,10 @@ class SynthesisRequest:
     # SLO priority class (serve.fleet.class_deadline_ms key); None means
     # the fleet's default_class — ignored by the single-engine batcher
     priority: Optional[str] = None
+    # style resolution already degraded to the default style upstream
+    # (the HTTP frontend's encoder call failed); carried through to the
+    # result so the response can say X-Style-Degraded
+    style_degraded: bool = False
 
 
 @dataclass
@@ -126,6 +132,9 @@ class SynthesisResult:
     bucket: Bucket
     batch_rows: int               # real rows in the dispatch that served this
     replica: int = -1             # fleet replica index (-1: single engine)
+    # the style for this request fell back to the default (all-zero FiLM)
+    # because the reference encoder failed — surfaced as X-Style-Degraded
+    style_degraded: bool = False
 
 
 @contextlib.contextmanager
@@ -167,6 +176,10 @@ class SynthesisEngine:
         model=None,
         registry: Optional[MetricsRegistry] = None,
         style: Optional[StyleService] = None,
+        fault_plan: Optional[FaultPlan] = None,  # SPEAKINGSTYLE_FAULTS
+        # plan (cli/serve.py threads one shared plan fleet-wide);
+        # consumes vocoder_raise@N (N = Nth vocode_window call on this
+        # engine, 1-based). None = no injection.
     ):
         from speakingstyle_tpu.models.factory import build_model
 
@@ -204,7 +217,9 @@ class SynthesisEngine:
         if style is not None:
             self.style = style
         elif self._use_style:
-            self.style = StyleService(cfg, variables, registry=self.registry)
+            self.style = StyleService(
+                cfg, variables, registry=self.registry, fault_plan=fault_plan
+            )
         else:
             self.style = None
         self._compiles = self.registry.counter(
@@ -226,6 +241,17 @@ class SynthesisEngine:
         self._acoustic_cards: Dict[Bucket, ProgramCard] = {}
         self._vocoder_cards: Dict[Tuple[int, int], ProgramCard] = {}
         self._lock = threading.Lock()  # compile-on-miss exclusion
+        self.fault_plan = fault_plan
+        # vocoder_raise@N indexes this 1-based call counter; an int (not
+        # itertools.count) so chaos drills can read ``vocode_calls`` and
+        # arm a live plan at the NEXT call
+        self._vocode_calls = 0
+        self._vocode_calls_lock = threading.Lock()
+        self._style_degraded_ctr = self.registry.counter(
+            "serve_style_degraded_total",
+            help="requests whose style fell back to the default (all-zero "
+                 "FiLM) because the reference encoder failed",
+        )
 
     @property
     def compile_count(self) -> int:
@@ -236,6 +262,14 @@ class SynthesisEngine:
     @property
     def dispatch_count(self) -> int:
         return int(self._dispatches.value)
+
+    @property
+    def vocode_calls(self) -> int:
+        """``vocode_window`` calls so far — the counter
+        ``vocoder_raise@N`` indexes; arm a live plan at
+        ``vocode_calls + 1`` to fault the next window."""
+        with self._vocode_calls_lock:
+            return self._vocode_calls
 
     @property
     def is_ready(self) -> bool:
@@ -393,6 +427,17 @@ class SynthesisEngine:
             raise ValueError(
                 f"mel window must be [T, {self.n_mels}], got {mel.shape}"
             )
+        with self._vocode_calls_lock:
+            self._vocode_calls += 1
+            call = self._vocode_calls
+        if self.fault_plan is not None and self.fault_plan.fire(
+            "vocoder_raise", call
+        ):
+            # a stream continuation fault: non-idempotent, so the stream
+            # aborts (truncated chunked body) rather than being retried
+            raise InjectedFault(
+                f"injected vocoder_raise at vocode_window call {call}"
+            )
         t_w = mel.shape[0]
         key = self.lattice.cover_window(t_w)
         with self._lock:
@@ -480,7 +525,15 @@ class SynthesisEngine:
         """Per-request FiLM vectors: precomputed ones pass through;
         raw ``ref_mel``s resolve through the StyleService cache-first
         (one batched encoder dispatch covers all fresh references —
-        duplicates and repeats cost zero encoder work)."""
+        duplicates and repeats cost zero encoder work).
+
+        Graceful degradation: an encoder failure falls back to the
+        default style (all-zero FiLM — ``StyleService.fallback_style``)
+        for the affected requests instead of failing the whole coalesced
+        batch; the request is flagged so the HTTP response carries
+        ``X-Style-Degraded``.  The failed encode never reached the cache
+        (style.py inserts only after a successful round-trip), so the
+        same reference encodes fresh on its next request."""
         if not self._use_style:
             return [None] * len(requests)
         styles: List[Optional[StyleVectors]] = [r.style for r in requests]
@@ -495,7 +548,21 @@ class SynthesisEngine:
                 mels.append(r.ref_mel)
                 idxs.append(i)
         if mels:
-            for i, sv in zip(idxs, self.style.encode_mels(mels)):
+            try:
+                encoded = self.style.encode_mels(mels)
+            except Exception as e:
+                fallback = self.style.fallback_style()
+                encoded = [fallback] * len(mels)
+                self._style_degraded_ctr.inc(len(idxs))
+                for i in idxs:
+                    requests[i].style_degraded = True
+                self.registry.counter(
+                    "serve_style_encode_failures_total",
+                    labels={"error": type(e).__name__},
+                    help="reference-encoder dispatch failures absorbed by "
+                         "the default-style fallback",
+                ).inc()
+            for i, sv in zip(idxs, encoded):
                 styles[i] = sv
         return styles
 
@@ -626,5 +693,6 @@ class SynthesisEngine:
                 src_len=src_len,
                 bucket=bucket,
                 batch_rows=n,
+                style_degraded=r.style_degraded,
             ))
         return results

@@ -33,6 +33,19 @@ Every replica preserves the engine's zero-steady-state-compiles
 invariant independently: the router never creates programs, it only
 routes into each replica's precompiled lattice (streaming windows
 included — serving/streaming.py rides the same vocoder buckets).
+
+**Supervision** (serving/resilience.py, ARCHITECTURE.md "Serving
+resilience"): a replica whose dispatch raises — or exceeds the
+``fleet.hang_watchdog_s`` watchdog — transitions to a sixth lifecycle
+state, ``failed``; its in-flight requests are requeued onto healthy
+replicas (exactly-once: the hung worker's late results are discarded via
+a claim handshake on ``Replica.inflight``), each burning one unit of its
+class's ``fleet.retry_budget`` before resolving as ``ReplicaError``.
+The failed replica is circuit-broken with exponential-backoff re-warm
+through the same cold → warming → ready lifecycle (cheap under the
+persistent compile cache).  EDF is also an enforced guarantee now: a
+request popped past its class deadline budget resolves as
+``DeadlineExceeded`` (504) instead of dispatching late.
 """
 
 import heapq
@@ -44,6 +57,7 @@ from typing import Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 
+from speakingstyle_tpu.faults import FaultPlan
 from speakingstyle_tpu.obs import JsonlEventLog, MetricsRegistry
 from speakingstyle_tpu.serving import streaming
 from speakingstyle_tpu.serving.batcher import Overloaded, ShutdownError
@@ -54,6 +68,13 @@ from speakingstyle_tpu.serving.engine import (
     bucket_label,
 )
 from speakingstyle_tpu.serving.lattice import BucketLattice, StyleLattice
+from speakingstyle_tpu.serving.resilience import (
+    CircuitBreaker,
+    DeadlineExceeded,
+    DispatchError,
+    InjectedFault,
+    ReplicaError,
+)
 
 # replica lifecycle states (serve_replica_state gauge values in parens)
 COLD = "cold"          # (0) constructed, nothing compiled
@@ -61,7 +82,10 @@ WARMING = "warming"    # (1) building the engine / precompiling the lattice
 READY = "ready"        # (2) dispatching
 DRAINING = "draining"  # (3) finishing in-flight work, admitting nothing
 STOPPED = "stopped"    # (4) worker exited
-STATE_CODE = {COLD: 0, WARMING: 1, READY: 2, DRAINING: 3, STOPPED: 4}
+FAILED = "failed"      # (5) dispatch raised/hung; circuit-broken, awaiting
+#                            its breaker backoff before a re-warm trial
+STATE_CODE = {COLD: 0, WARMING: 1, READY: 2, DRAINING: 3, STOPPED: 4,
+              FAILED: 5}
 
 
 @dataclass(order=True)
@@ -74,17 +98,31 @@ class _Pending:
     future: Future = field(compare=False)
     dispatch_by: float = field(compare=False)  # coalescing deadline
     klass: str = field(compare=False)
+    # replica-failure requeues survived so far (bounded by the class's
+    # fleet.retry_budget)
+    retries: int = field(compare=False, default=0)
 
 
 class Replica:
     """One engine plus its lifecycle state and dispatch thread."""
 
-    def __init__(self, index: int):
+    def __init__(self, index: int, breaker: CircuitBreaker):
         self.index = index
         self.engine: Optional[SynthesisEngine] = None
         self.state = COLD
         self.error: Optional[BaseException] = None
         self.worker: Optional[threading.Thread] = None
+        self.breaker = breaker
+        # exactly-once handshake with the hang watchdog: the batch this
+        # replica is dispatching right now.  The worker claims results
+        # back under the router lock; if the supervisor stole the batch
+        # first (hang), the worker finds ``inflight is not batch`` and
+        # discards.  ``generation`` orphans a hung worker across a
+        # re-warm: state transitions from a stale generation are ignored.
+        self.inflight: Optional[List["_Pending"]] = None
+        self.dispatch_started: Optional[float] = None
+        self.dispatch_n = 0
+        self.generation = 0
 
 
 class FleetRouter:
@@ -108,6 +146,10 @@ class FleetRouter:
         # builds one and closes the factory over it): one embedding
         # cache, one encoder lattice — a style uploaded once is warm
         # fleet-wide. None = replicas own private services (tests).
+        fault_plan: Optional[FaultPlan] = None,  # SPEAKINGSTYLE_FAULTS
+        # plan threaded in by cli/serve.py / bench --chaos; consumes the
+        # replica_raise@N / replica_hang@N kinds (N = router-global
+        # dispatch counter, 1-based). None = no injection.
     ):
         serve = cfg.serve
         fleet = serve.fleet
@@ -132,6 +174,10 @@ class FleetRouter:
         self._shedding = False
         self._replicas: List[Replica] = []
         self._stream_overlap: Optional[int] = None
+        self.fault_plan = fault_plan
+        self._dispatch_total = 0  # router-global, under self._cond; the
+        # counter the replica_raise@N / replica_hang@N fault kinds index
+        self._watchdog = fleet.hang_watchdog_s
 
         self._shed_ctr = self.registry.counter(
             "serve_shed_total",
@@ -151,7 +197,23 @@ class FleetRouter:
             "serve_ttfa_seconds",
             help="request arrival -> first streamed wav chunk ready",
         )
+        self._requeued_ctr = self.registry.counter(
+            "serve_requeued_total",
+            help="in-flight requests requeued off a failed replica",
+        )
         self.scale_to(replicas if replicas is not None else fleet.replicas)
+        # the supervisor owns the hang watchdog and the breaker re-warm
+        # schedule; it wakes on the cond (close notifies it) or every
+        # interval, whichever is sooner
+        self._supervise_interval = max(0.005, min(
+            0.25,
+            fleet.rewarm_backoff_s / 2.0,
+            self._watchdog / 4.0 if self._watchdog > 0 else 0.25,
+        ))
+        self._supervisor = threading.Thread(
+            target=self._supervise, name="fleet-supervisor", daemon=True
+        )
+        self._supervisor.start()
 
     # -- replica lifecycle --------------------------------------------------
 
@@ -162,13 +224,20 @@ class FleetRouter:
             "serve_replica_state",
             labels={"replica": str(rep.index)},
             help="replica lifecycle: 0=cold 1=warming 2=ready 3=draining "
-                 "4=stopped",
+                 "4=stopped 5=failed",
         ).set(STATE_CODE[state])
         if self.events is not None:
             self.events.emit(
                 "replica_state", replica=rep.index, state=state
             )
         self._cond.notify_all()
+
+    def _set_breaker_gauge(self, rep: Replica) -> None:
+        self.registry.gauge(
+            "serve_replica_breaker_state",
+            labels={"replica": str(rep.index)},
+            help="replica circuit breaker: 0=closed 1=open 2=half_open",
+        ).set(rep.breaker.code)
 
     def scale_to(self, n: int) -> None:
         """Elastically grow or shrink the ready+warming replica set.
@@ -185,18 +254,22 @@ class FleetRouter:
             if self._closing:
                 raise ShutdownError("router is closed")
             live = [r for r in self._replicas
-                    if r.state in (COLD, WARMING, READY)]
+                    if r.state in (COLD, WARMING, READY, FAILED)]
             for rep in live[n:]:          # shrink newest-first
                 if rep.state == READY:
                     self._set_state(rep, DRAINING)
-                else:
+                else:   # cold/warming/failed: nothing in flight to drain
                     self._set_state(rep, STOPPED)
             grow = n - len(live)
             new = []
             for _ in range(max(0, grow)):
-                rep = Replica(len(self._replicas))
+                rep = Replica(len(self._replicas), CircuitBreaker(
+                    self.fleet.rewarm_backoff_s,
+                    self.fleet.rewarm_backoff_max_s,
+                ))
                 self._replicas.append(rep)
                 self._set_state(rep, COLD)
+                self._set_breaker_gauge(rep)
                 new.append(rep)
         for rep in new:
             t = threading.Thread(
@@ -223,10 +296,18 @@ class FleetRouter:
         except BaseException as e:
             rep.error = e
             with self._cond:
-                self._set_state(rep, STOPPED)
+                if rep.breaker.state == "half_open":
+                    # a re-warm trial failed: re-open the breaker with a
+                    # doubled backoff and try again later, instead of
+                    # giving the replica up for good
+                    rep.breaker.record_failure(time.monotonic())
+                    self._set_breaker_gauge(rep)
+                    self._set_state(rep, FAILED)
+                else:       # initial warm-up never worked: stop for good
+                    self._set_state(rep, STOPPED)
             if self.events is not None:
                 self.events.emit(
-                    "replica_state", replica=rep.index, state="failed",
+                    "replica_warm_failed", replica=rep.index,
                     error=type(e).__name__,
                 )
             return
@@ -234,9 +315,11 @@ class FleetRouter:
             if rep.state != WARMING:  # shrunk away mid-warm-up
                 return
             rep.engine = engine
+            rep.generation += 1       # orphan any worker from a past life
+            gen = rep.generation
             self._set_state(rep, READY)
         rep.worker = threading.Thread(
-            target=self._worker, args=(rep,),
+            target=self._worker, args=(rep, gen),
             name=f"replica-{rep.index}-dispatch", daemon=True,
         )
         rep.worker.start()
@@ -349,100 +432,354 @@ class FleetRouter:
 
     # -- dispatch -----------------------------------------------------------
 
+    @property
+    def dispatch_total(self) -> int:
+        """Router-global dispatch count so far — the counter the
+        ``replica_raise@N``/``replica_hang@N`` fault kinds index
+        (``bench.py --chaos`` reads this to arm a kill that has not
+        happened yet)."""
+        with self._cond:
+            return self._dispatch_total
+
     def _collect(self, rep: Replica) -> Optional[List[_Pending]]:
         """EDF pop + coalesce for one replica. None = worker should exit
-        (draining or closed-and-drained)."""
-        with self._cond:
-            while not self._heap:
-                if rep.state != READY or self._closing:
-                    return None
-                self._cond.wait(timeout=0.5)
-            batch = [heapq.heappop(self._heap)]
-            while len(batch) < self.max_batch:
-                if self._heap:
-                    batch.append(heapq.heappop(self._heap))
-                    continue
-                if self._closing or rep.state != READY:
-                    break
-                wait = min(p.dispatch_by for p in batch) - time.monotonic()
-                if wait <= 0:
-                    break
-                self._cond.wait(timeout=wait)
-            self._pending_gauge.set(len(self._heap))
-            return batch
+        (draining or closed-and-drained).
 
-    def _dispatch(self, rep: Replica, batch: List[_Pending]) -> None:
+        Deadline enforcement happens here: a pending popped past its SLO
+        deadline is never dispatched — it resolves as DeadlineExceeded
+        (504) once the lock is released.  The returned batch is also
+        registered as the replica's in-flight claim for the hang
+        watchdog before the lock is dropped, and stamped with its
+        router-global dispatch number (``rep.dispatch_n`` — the counter
+        the fault kinds index) while still under the lock.
+        """
+        expired: List[_Pending] = []
+        batch: Optional[List[_Pending]] = None
+        with self._cond:
+            while batch is None:
+                if not self._heap:
+                    if rep.state != READY or self._closing:
+                        break
+                    self._cond.wait(timeout=0.5)
+                    continue
+                p = heapq.heappop(self._heap)
+                if time.monotonic() > p.slo_deadline:
+                    expired.append(p)
+                    continue
+                batch = [p]
+            if batch is not None:
+                while len(batch) < self.max_batch:
+                    if self._heap:
+                        p = heapq.heappop(self._heap)
+                        if time.monotonic() > p.slo_deadline:
+                            expired.append(p)
+                            continue
+                        batch.append(p)
+                        continue
+                    if self._closing or rep.state != READY:
+                        break
+                    wait = (min(q.dispatch_by for q in batch)
+                            - time.monotonic())
+                    if wait <= 0:
+                        break
+                    self._cond.wait(timeout=wait)
+                self._dispatch_total += 1
+                rep.dispatch_n = self._dispatch_total
+                rep.inflight = batch
+                rep.dispatch_started = time.monotonic()
+            self._pending_gauge.set(len(self._heap))
+        for p in expired:
+            self._resolve_deadline_exceeded(p)
+        return batch
+
+    def _resolve_deadline_exceeded(self, p: _Pending) -> None:
+        """Resolve one pending as DeadlineExceeded. Caller must already
+        have removed it from the heap / any in-flight batch."""
+        self.registry.counter(
+            "serve_deadline_exceeded_total", labels={"class": p.klass},
+            help="requests resolved 504 instead of dispatched past their "
+                 "class deadline budget",
+        ).inc()
+        if self.events is not None:
+            self.events.emit(
+                "deadline_exceeded", req_id=p.request.id, klass=p.klass,
+                retries=p.retries,
+            )
+        budget = self.fleet.class_deadline_ms[p.klass]
+        p.future.set_exception(DeadlineExceeded(
+            f"request {p.request.id!r} exceeded its {p.klass!r} deadline "
+            f"budget ({budget:g} ms) before dispatch",
+            klass=p.klass, budget_ms=budget,
+        ))
+
+    def _claim(self, rep: Replica, batch: List[_Pending]) -> bool:
+        """Take the in-flight batch back from the watchdog.  False means
+        the supervisor stole it (hang): the caller owns nothing and must
+        discard whatever the engine eventually returned."""
+        with self._cond:
+            if rep.inflight is not batch:
+                return False
+            rep.inflight = None
+            rep.dispatch_started = None
+            return True
+
+    def _dispatch(self, rep: Replica, gen: int,
+                  batch: List[_Pending]) -> bool:
+        """Run one coalesced batch on the replica. Returns False when the
+        replica failed (or its results were stolen by the hang watchdog)
+        and the worker loop must exit — supervision owns the replica's
+        state from that point."""
         req_ids = [p.request.id for p in batch]
+        n = rep.dispatch_n        # stamped under the lock in _collect
         t0 = time.monotonic()
         try:
+            if self.fault_plan is not None:
+                if self.fault_plan.fire("replica_raise", n):
+                    raise InjectedFault(
+                        f"injected replica_raise at dispatch {n}"
+                    )
+                if self.fault_plan.fire("replica_hang", n):
+                    # stall past the watchdog, then fall through to a
+                    # real dispatch: exercises the stolen-results path
+                    time.sleep(
+                        3.0 * self._watchdog if self._watchdog > 0 else 0.5
+                    )
             results = rep.engine.run([p.request for p in batch])
         except BaseException as e:
+            if not self._claim(rep, batch):
+                return False   # watchdog already failed us and requeued
             if self.events is not None:
                 self.events.emit(
                     "fleet_dispatch", replica=rep.index, req_ids=req_ids,
                     rows=len(batch), duration_s=time.monotonic() - t0,
                     ok=False, error=type(e).__name__,
                 )
-            for p in batch:
-                p.future.set_exception(e)
-            return
+            self._replica_failed(rep, batch, e, kind="raise")
+            return False
+        if not self._claim(rep, batch):
+            # hung past the watchdog, then finished anyway: the requests
+            # were requeued elsewhere — these results are orphans
+            if self.events is not None:
+                self.events.emit(
+                    "dispatch_discarded", replica=rep.index,
+                    req_ids=req_ids, duration_s=time.monotonic() - t0,
+                )
+            return False
         now = time.monotonic()
-        self.registry.counter(
-            "serve_batch_occupancy_total", labels={"rows": str(len(batch))},
-            help="dispatches by real-row occupancy",
-        ).inc()
-        self.registry.counter(
-            "serve_replica_dispatches_total",
-            labels={"replica": str(rep.index)},
-            help="coalesced dispatches executed per replica",
-        ).inc()
-        self.registry.counter(
-            "serve_replica_requests_total",
-            labels={"replica": str(rep.index)},
-            help="requests served per replica",
-        ).inc(len(batch))
-        # engines are duck-typed in tests (the batcher's convention)
-        bucket = getattr(results[0], "bucket", None) if results else None
+        try:
+            self.registry.counter(
+                "serve_batch_occupancy_total",
+                labels={"rows": str(len(batch))},
+                help="dispatches by real-row occupancy",
+            ).inc()
+            self.registry.counter(
+                "serve_replica_dispatches_total",
+                labels={"replica": str(rep.index)},
+                help="coalesced dispatches executed per replica",
+            ).inc()
+            self.registry.counter(
+                "serve_replica_requests_total",
+                labels={"replica": str(rep.index)},
+                help="requests served per replica",
+            ).inc(len(batch))
+            # engines are duck-typed in tests (the batcher's convention)
+            bucket = getattr(results[0], "bucket", None) if results else None
+            if self.events is not None:
+                self.events.emit(
+                    "fleet_dispatch", replica=rep.index, req_ids=req_ids,
+                    rows=len(batch),
+                    bucket=(bucket_label(bucket) if bucket is not None
+                            else None),
+                    duration_s=now - t0,
+                )
+            if rep.breaker.state != "closed":
+                # first good dispatch after a re-warm trial: close it
+                rep.breaker.record_success()
+                with self._cond:
+                    self._set_breaker_gauge(rep)
+            for p, r in zip(batch, results):
+                r.replica = rep.index
+                self._latency_hist.observe(now - p.request.arrival)
+                if now > p.slo_deadline:
+                    self.registry.counter(
+                        "serve_deadline_miss_total",
+                        labels={"class": p.klass},
+                        help="requests completed past their SLO deadline",
+                    ).inc()
+                p.future.set_result(r)
+        except BaseException as e:
+            # bookkeeping bug AFTER a successful engine call: resolve the
+            # affected futures with a structured error and keep the loop
+            # alive — a raise here used to kill the dispatch thread and
+            # strand the queue
+            self.registry.counter(
+                "serve_dispatch_errors_total",
+                help="dispatch-loop bookkeeping errors resolved as "
+                     "DispatchError (500) without killing the worker",
+            ).inc()
+            if self.events is not None:
+                self.events.emit(
+                    "dispatch_error", replica=rep.index, req_ids=req_ids,
+                    error=type(e).__name__,
+                )
+            err = DispatchError(
+                f"dispatch bookkeeping failed on replica {rep.index}: "
+                f"{type(e).__name__}: {e}"
+            )
+            for p in batch:
+                if not p.future.done():
+                    p.future.set_exception(err)
+        return True
+
+    def _replica_failed(self, rep: Replica, batch: List[_Pending],
+                        error: BaseException, kind: str) -> None:
+        """Fail one replica and requeue its in-flight batch onto healthy
+        replicas. Called by the worker (dispatch raised) or by the
+        supervisor (hang watchdog); the caller must already own ``batch``
+        exclusively (claimed or stolen)."""
+        now = time.monotonic()
+        expired: List[_Pending] = []
+        exhausted: List[_Pending] = []
+        shutdown: List[_Pending] = []
+        requeued: List[_Pending] = []
+        with self._cond:
+            rep.error = error
+            if rep.state in (READY, DRAINING):
+                # a DRAINING replica was being shrunk away: do not
+                # resurrect it — requeue its batch but stop it for good
+                target = FAILED if rep.state == READY else STOPPED
+                backoff = rep.breaker.record_failure(now)
+                self._set_breaker_gauge(rep)
+                self._set_state(rep, target)
+            else:
+                backoff = rep.breaker.retry_at() - now
+            self.registry.counter(
+                "serve_replica_failures_total",
+                labels={"replica": str(rep.index)},
+                help="dispatch failures (raise or hang) per replica",
+            ).inc()
+            for p in batch:
+                budget = self.fleet.retry_budget.get(p.klass, 0)
+                if self._closing:
+                    shutdown.append(p)
+                elif now > p.slo_deadline:
+                    expired.append(p)
+                elif p.retries >= budget:
+                    exhausted.append(p)
+                else:
+                    p.retries += 1
+                    requeued.append(p)
+            for p in requeued:
+                heapq.heappush(self._heap, p)
+                self._requeued_ctr.inc()
+                self.registry.counter(
+                    "serve_retries_total", labels={"class": p.klass},
+                    help="replica-failure retries consumed per class",
+                ).inc()
+            self._pending_gauge.set(len(self._heap))
+            self._cond.notify_all()
         if self.events is not None:
             self.events.emit(
-                "fleet_dispatch", replica=rep.index, req_ids=req_ids,
-                rows=len(batch),
-                bucket=bucket_label(bucket) if bucket is not None else None,
-                duration_s=now - t0,
+                "replica_failure", replica=rep.index, kind=kind,
+                error=type(error).__name__, req_ids=[
+                    p.request.id for p in batch
+                ],
+                requeued=[p.request.id for p in requeued],
+                failed=[p.request.id for p in exhausted],
+                expired=[p.request.id for p in expired],
+                backoff_s=round(max(0.0, backoff), 6),
             )
-        for p, r in zip(batch, results):
-            r.replica = rep.index
-            self._latency_hist.observe(now - p.request.arrival)
-            if now > p.slo_deadline:
-                self.registry.counter(
-                    "serve_deadline_miss_total", labels={"class": p.klass},
-                    help="requests completed past their SLO deadline",
-                ).inc()
-            p.future.set_result(r)
+        for p in expired:
+            self._resolve_deadline_exceeded(p)
+        for p in shutdown:
+            p.future.set_exception(ShutdownError("router closed"))
+        for p in exhausted:
+            p.future.set_exception(ReplicaError(
+                f"request {p.request.id!r} ({p.klass!r}) exhausted its "
+                f"retry budget after replica {rep.index} failed: "
+                f"{type(error).__name__}: {error}"
+            ))
 
-    def _worker(self, rep: Replica) -> None:
+    def _supervise(self) -> None:
+        """Hang watchdog + breaker re-warm scheduler (one daemon thread
+        per router)."""
+        while True:
+            hung = []
+            rewarm = []
+            expired = []
+            with self._cond:
+                if self._closing:
+                    return
+                self._cond.wait(timeout=self._supervise_interval)
+                if self._closing:
+                    return
+                now = time.monotonic()
+                # the heap is EDF-ordered, so expired work is at the
+                # front: sweep it here too, so deadlines resolve even
+                # when no worker is popping (e.g. every replica failed)
+                while self._heap and now > self._heap[0].slo_deadline:
+                    expired.append(heapq.heappop(self._heap))
+                if expired:
+                    self._pending_gauge.set(len(self._heap))
+                for rep in self._replicas:
+                    if (self._watchdog > 0 and rep.state == READY
+                            and rep.inflight is not None
+                            and rep.dispatch_started is not None
+                            and now - rep.dispatch_started > self._watchdog):
+                        # steal the batch: the hung worker will find its
+                        # claim gone and discard whatever it returns
+                        batch = rep.inflight
+                        rep.inflight = None
+                        rep.dispatch_started = None
+                        hung.append((rep, batch))
+                    elif (rep.state == FAILED
+                          and rep.breaker.ready_to_trial(now)):
+                        rep.breaker.begin_trial()
+                        self._set_breaker_gauge(rep)
+                        self._set_state(rep, COLD)
+                        rewarm.append(rep)
+            for p in expired:
+                self._resolve_deadline_exceeded(p)
+            for rep, batch in hung:
+                self._replica_failed(rep, batch, TimeoutError(
+                    f"replica {rep.index} dispatch exceeded the "
+                    f"{self._watchdog:g}s hang watchdog"
+                ), kind="hang")
+            for rep in rewarm:
+                threading.Thread(
+                    target=self._warm, args=(rep,),
+                    name=f"replica-{rep.index}-rewarm", daemon=True,
+                ).start()
+
+    def _worker(self, rep: Replica, gen: int) -> None:
         try:
             while True:
                 batch = self._collect(rep)
                 if batch is None:
                     break
-                self._dispatch(rep, batch)
-        except BaseException as e:  # engine errors are handled per-batch;
-            # anything here is a harness bug — fail waiters loudly
+                if not self._dispatch(rep, gen, batch):
+                    return  # replica failed/orphaned; supervision owns it
+        except BaseException as e:  # engine + bookkeeping errors are
+            # handled inside _dispatch; anything here is a harness bug —
+            # fail waiters loudly
             self._fail_pending(e)
             raise
         finally:
             with self._cond:
-                self._set_state(rep, STOPPED)
+                # do not stomp FAILED (supervision owns it) or a newer
+                # generation's state after a re-warm
+                if rep.generation == gen and rep.state in (READY, DRAINING):
+                    self._set_state(rep, STOPPED)
 
     def _fail_pending(self, error: BaseException) -> None:
         with self._cond:
             pending, self._heap = self._heap, []
             self._pending_gauge.set(0)
         for p in pending:
-            p.future.set_exception(
-                ShutdownError(f"fleet router closed: {error!r}")
-            )
+            if not p.future.done():
+                p.future.set_exception(
+                    ShutdownError(f"fleet router closed: {error!r}")
+                )
 
     # -- streaming ----------------------------------------------------------
 
@@ -455,13 +792,23 @@ class FleetRouter:
         chunk when ``arrival`` (a monotonic stamp) is given."""
         with self._cond:
             reps = {r.index: r for r in self._replicas}
-        rep = reps.get(result.replica)
-        if rep is None or rep.engine is None:
-            raise ValueError(
-                f"result {result.id!r} carries no live replica "
-                f"(replica={result.replica})"
-            )
-        engine = rep.engine
+            rep = reps.get(result.replica)
+            if rep is None or rep.engine is None:
+                raise ValueError(
+                    f"result {result.id!r} carries no live replica "
+                    f"(replica={result.replica})"
+                )
+            if rep.state not in (READY, DRAINING):
+                # stream continuations are non-idempotent: they are never
+                # transparently retried on another replica (a re-warmed
+                # replica going READY again serves them fine — vocode
+                # windows are stateless)
+                raise ReplicaError(
+                    f"stream for result {result.id!r} lost replica "
+                    f"{result.replica} (state={rep.state!r}); stream "
+                    "continuations are not retried"
+                )
+            engine = rep.engine
         if self._stream_overlap is None:
             gen, _ = engine.vocoder
             self._stream_overlap = streaming.resolve_overlap(
@@ -484,10 +831,12 @@ class FleetRouter:
         ShutdownError. In-flight dispatches always complete."""
         with self._cond:
             self._closing = True
-            # replicas still cold/warming will never be needed: stop them
-            # now so a late warm-up cannot go READY into a closed router
+            # replicas still cold/warming will never be needed — and a
+            # failed replica must not be re-warmed into a closed router:
+            # stop them all now (also wakes the supervisor, which exits
+            # on _closing)
             for rep in self._replicas:
-                if rep.state in (COLD, WARMING):
+                if rep.state in (COLD, WARMING, FAILED):
                     self._set_state(rep, STOPPED)
             workers = [r.worker for r in self._replicas if r.worker]
             self._cond.notify_all()

@@ -1,0 +1,138 @@
+"""Serving resilience: structured failure types and the replica circuit
+breaker.
+
+This is the serving counterpart of ``training/resilience.py``.  Training
+recovers a *single* long-lived process (rollback, checkpoint, SIGTERM);
+serving recovers a *fleet* — a replica that raises or hangs mid-dispatch
+must not strand its in-flight futures, and a client must never block
+unboundedly on a request the fleet can no longer serve on time.  The
+pieces here are deliberately engine-free (stdlib only) so fleet.py,
+batcher.py, server.py and the tests can all import them without cycles:
+
+  exceptions   the structured terminal states a future can resolve to,
+               each with a fixed HTTP mapping (see ARCHITECTURE.md's
+               failure-mode table):
+                 DeadlineExceeded -> 504   past its class deadline budget
+                 ReplicaError     -> 503   retry budget exhausted / stream
+                                           continuation lost its replica
+                 DispatchError    -> 500   dispatch-loop bookkeeping bug
+                 InjectedFault              what SPEAKINGSTYLE_FAULTS
+                                            raises at serving fault points
+                                            (a transient RuntimeError to
+                                            the supervision machinery)
+
+  CircuitBreaker   per-replica closed/open/half-open state with
+               exponential backoff.  A dispatch failure opens the
+               breaker; after the backoff the router re-warms the
+               replica (the trial — half-open); the first successful
+               dispatch closes it and resets the backoff, a failure
+               while half-open re-opens it with the backoff doubled.
+               The breaker itself is pure state under a lock — the
+               router owns the clock, the re-warm thread, and the
+               ``serve_replica_breaker_state`` gauge.
+
+Fault *kinds* and the spec grammar live in the shared top-level
+``speakingstyle_tpu/faults.py``; this module only defines what firing
+one raises.
+"""
+
+import threading
+
+# serve_replica_breaker_state gauge values, mirroring fleet.STATE_CODE.
+BREAKER_CODE = {"closed": 0, "open": 1, "half_open": 2}
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a SPEAKINGSTYLE_FAULTS serving fault point.  Transient
+    by construction: supervision treats it exactly like a real device
+    error, which is the point of the chaos drills."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request sat past its class deadline budget; resolved instead
+    of dispatched late.  Maps to HTTP 504."""
+
+    def __init__(self, message: str, klass: str = "", budget_ms: float = 0.0):
+        super().__init__(message)
+        self.klass = klass
+        self.budget_ms = budget_ms
+
+
+class ReplicaError(RuntimeError):
+    """The request's replica failed and its per-class retry budget is
+    exhausted, or a non-idempotent stream continuation lost its replica
+    (streams are never transparently retried).  Maps to HTTP 503."""
+
+
+class DispatchError(RuntimeError):
+    """An unexpected exception in a dispatch loop's bookkeeping (not the
+    engine call itself).  The loop resolves the affected futures with
+    this and stays alive.  Maps to HTTP 500."""
+
+
+class CircuitBreaker:
+    """Per-replica breaker: closed -> open (on failure, with exponential
+    backoff) -> half-open (re-warm trial) -> closed (first success).
+
+    Pure state; callers pass ``now`` explicitly (``time.monotonic()``)
+    so tests can drive the clock.  Thread-safe: the replica worker, the
+    hang watchdog, and the re-warm scheduler all touch it.
+    """
+
+    def __init__(self, backoff_s: float, backoff_max_s: float):
+        if backoff_s <= 0 or backoff_max_s < backoff_s:
+            raise ValueError(
+                f"breaker backoff must satisfy 0 < backoff_s <= backoff_max_s; "
+                f"got {backoff_s} / {backoff_max_s}"
+            )
+        self._base = float(backoff_s)
+        self._max = float(backoff_max_s)
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._consecutive = 0
+        self._retry_at = 0.0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def code(self) -> int:
+        return BREAKER_CODE[self.state]
+
+    @property
+    def consecutive_failures(self) -> int:
+        with self._lock:
+            return self._consecutive
+
+    def record_failure(self, now: float) -> float:
+        """Open the breaker; returns the backoff applied (doubling per
+        consecutive failure, capped at backoff_max_s)."""
+        with self._lock:
+            backoff = min(self._max, self._base * (2.0 ** self._consecutive))
+            self._consecutive += 1
+            self._state = "open"
+            self._retry_at = now + backoff
+            return backoff
+
+    def ready_to_trial(self, now: float) -> bool:
+        """True when the breaker is open and the backoff has elapsed —
+        the router may start a re-warm trial."""
+        with self._lock:
+            return self._state == "open" and now >= self._retry_at
+
+    def begin_trial(self) -> None:
+        with self._lock:
+            self._state = "half_open"
+
+    def record_success(self) -> None:
+        """First successful dispatch after a trial: close and reset."""
+        with self._lock:
+            self._state = "closed"
+            self._consecutive = 0
+            self._retry_at = 0.0
+
+    def retry_at(self) -> float:
+        with self._lock:
+            return self._retry_at

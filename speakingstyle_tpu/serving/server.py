@@ -102,6 +102,11 @@ from speakingstyle_tpu.serving.batcher import (
 )
 from speakingstyle_tpu.serving.engine import SynthesisEngine, SynthesisRequest
 from speakingstyle_tpu.serving.lattice import RequestTooLarge
+from speakingstyle_tpu.serving.resilience import (
+    DeadlineExceeded,
+    DispatchError,
+    ReplicaError,
+)
 
 
 def wav_bytes(wav: np.ndarray, sampling_rate: int) -> bytes:
@@ -192,10 +197,16 @@ class TextFrontend:
         return idx
 
     def resolve_style(self, payload: Dict):
-        """(style_vectors | None, ref_mel | None) for one request payload
-        — exactly one of the two is non-None."""
+        """(style_vectors | None, ref_mel | None, degraded) for one
+        request payload — exactly one of the first two is non-None.
+
+        Graceful degradation: when the style *encoder* fails (a device
+        error, not a client mistake — ValueError still means 400), the
+        request proceeds on the default style (all-zero FiLM) with
+        ``degraded=True``, which the HTTP layer surfaces as
+        ``X-Style-Degraded: 1`` instead of failing the synthesis."""
         if not self.cfg.model.use_reference_encoder:
-            return None, None  # no FiLM conditioning in this model
+            return None, None, False  # no FiLM conditioning in this model
         style_id = payload.get("style_id")
         ref_audio = payload.get("ref_audio")
         if style_id is not None and ref_audio is not None:
@@ -206,19 +217,27 @@ class TextFrontend:
                     "style_id requires a style service (the model has no "
                     "reference encoder)"
                 )
+            # pure cache lookup — nothing to degrade; a miss stays 400
             entry = self.style.get(str(style_id))
             if entry is None:
                 raise ValueError(
                     f"unknown style_id {style_id!r} (upload the reference "
                     "via POST /styles first)"
                 )
-            return entry, None
+            return entry, None, False
         if ref_audio is not None:
             path = confined_ref_path(self.cfg, str(ref_audio))
             if self.style is not None:
                 with open(path, "rb") as f:
-                    return self.style.encode_wav_bytes(f.read()), None
-            return None, load_ref_mel(self.cfg, path)
+                    data = f.read()
+                try:
+                    return self.style.encode_wav_bytes(data), None, False
+                except ValueError:
+                    raise  # malformed reference: the client's problem
+                except Exception as e:
+                    self._style_encode_failed(e)
+                    return self.style.fallback_style(), None, True
+            return None, load_ref_mel(self.cfg, path), False
         if self.default_ref_mel is None:
             raise ValueError(
                 'no reference style: pass "style_id" (POST /styles), '
@@ -226,8 +245,26 @@ class TextFrontend:
                 "server with --ref_audio"
             )
         if self.style is not None:
-            return self.style.encode_mel(self.default_ref_mel), None
-        return None, self.default_ref_mel
+            try:
+                return self.style.encode_mel(self.default_ref_mel), None, \
+                    False
+            except ValueError:
+                raise
+            except Exception as e:
+                self._style_encode_failed(e)
+                return self.style.fallback_style(), None, True
+        return None, self.default_ref_mel, False
+
+    def _style_encode_failed(self, e: BaseException) -> None:
+        """Degradation is absorbed, never silent: the failure lands on
+        the style service's registry (same counter the engine-side
+        fallback uses) before the request proceeds on the default style."""
+        self.style.registry.counter(
+            "serve_style_encode_failures_total",
+            labels={"error": type(e).__name__},
+            help="reference-encoder dispatch failures absorbed by "
+                 "the default-style fallback",
+        ).inc()
 
     def controls_and_sequence(self, text: str, payload: Dict):
         """(sequence, p/e/d controls) for one request. Scalar controls
@@ -296,7 +333,7 @@ class TextFrontend:
         priority = payload.get("priority")
         if priority is not None and not isinstance(priority, str):
             raise ValueError("priority must be a string class name")
-        style_vec, ref_mel = self.resolve_style(payload)
+        style_vec, ref_mel, degraded = self.resolve_style(payload)
         spec = payload.get("speaker_id", payload.get("speaker"))
         speaker = self.speaker(spec) if spec is not None else 0
         # per-speaker style validation: a style bound to a registry
@@ -325,6 +362,7 @@ class TextFrontend:
             e_control=e_c,
             d_control=d_c,
             priority=priority,
+            style_degraded=degraded,
         )
 
 
@@ -621,6 +659,17 @@ class SynthesisServer:
                     }
                 except ShutdownError as e:
                     status, err = 503, str(e)
+                except DeadlineExceeded as e:
+                    # the router refused to dispatch past the class
+                    # deadline budget — same verdict as a result timeout
+                    status, err = 504, str(e)
+                except ReplicaError as e:
+                    # replica failed and the retry budget is spent: the
+                    # request may succeed on a retry once the fleet
+                    # re-warms — a 503, not a client error
+                    status, err = 503, str(e)
+                except DispatchError as e:
+                    status, err = 500, str(e)
                 # concurrent.futures.TimeoutError only aliases the builtin
                 # from 3.11; catch both on 3.10
                 except (TimeoutError, concurrent.futures.TimeoutError):
@@ -631,6 +680,10 @@ class SynthesisServer:
                                       req_id=req_id, headers=headers)
                 if stream:
                     return self._stream_response(result, req_id, parsed, t0)
+                degraded_hdr = (
+                    {"X-Style-Degraded": "1"} if result.style_degraded
+                    else None
+                )
                 if result.wav is None:
                     # vocoder-less engine: return the mel as JSON
                     outer._request_done(req_id, parsed.path, 200, t0)
@@ -638,7 +691,7 @@ class SynthesisServer:
                         "id": result.id,
                         "mel_len": result.mel_len,
                         "mel": result.mel.tolist(),
-                    }, req_id=req_id)
+                    }, req_id=req_id, headers=degraded_hdr)
                 sr = outer.cfg.preprocess.preprocessing.audio.sampling_rate
                 body = wav_bytes(result.wav, sr)
                 outer._request_done(req_id, parsed.path, 200, t0)
@@ -647,6 +700,8 @@ class SynthesisServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.send_header("X-Request-Id", result.id)
                 self.send_header("X-Batch-Rows", str(result.batch_rows))
+                if result.style_degraded:
+                    self.send_header("X-Style-Degraded", "1")
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -665,6 +720,8 @@ class SynthesisServer:
                 self.send_header("Transfer-Encoding", "chunked")
                 self.send_header("X-Request-Id", result.id)
                 self.send_header("X-Batch-Rows", str(result.batch_rows))
+                if result.style_degraded:
+                    self.send_header("X-Style-Degraded", "1")
                 self.end_headers()
                 try:
                     with outer.stream_scope():
@@ -721,6 +778,23 @@ class SynthesisServer:
     def next_req_id(self) -> str:
         return f"req{int(self._requests.inc()):08d}"
 
+    def _result_timeout(self, request) -> float:
+        """Wait on a submitted future no longer than the request's class
+        deadline budget (+ grace) allows.  The router resolves expired
+        work as DeadlineExceeded on its own; the grace window gives it
+        room to do so before the handler falls back to a bare 504.
+        Batcher deployments have no SLO classes — full timeout."""
+        if self.router is None:
+            return self.request_timeout
+        fleet = self.cfg.serve.fleet
+        klass = request.priority or fleet.default_class
+        budget_ms = fleet.class_deadline_ms.get(klass)
+        if budget_ms is None:
+            return self.request_timeout
+        deadline = request.arrival + (budget_ms + fleet.deadline_grace_ms) / 1e3
+        remaining = deadline - time.monotonic()
+        return max(0.001, min(self.request_timeout, remaining))
+
     def synthesize(self, payload: Dict, req_id: Optional[str] = None,
                    stream: bool = False):
         if req_id is None:
@@ -728,7 +802,7 @@ class SynthesisServer:
         request = self.frontend.request(req_id, payload)
         request.stream = stream   # mel-only dispatch; windows vocode after
         future = self.backend.submit(request)
-        return future.result(timeout=self.request_timeout)
+        return future.result(timeout=self._result_timeout(request))
 
     # -- streaming ----------------------------------------------------------
 

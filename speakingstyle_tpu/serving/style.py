@@ -53,9 +53,11 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from speakingstyle_tpu.configs.config import Config
+from speakingstyle_tpu.faults import FaultPlan
 from speakingstyle_tpu.obs import MetricsRegistry
 from speakingstyle_tpu.obs.cost import ProgramCard, publish_program_gauges
 from speakingstyle_tpu.serving.lattice import StyleLattice
+from speakingstyle_tpu.serving.resilience import InjectedFault
 
 __all__ = [
     "StyleService",
@@ -141,6 +143,10 @@ class StyleService:
         variables: Dict,
         registry: Optional[MetricsRegistry] = None,
         speaker_map: Optional[Dict[str, int]] = None,
+        fault_plan: Optional[FaultPlan] = None,  # SPEAKINGSTYLE_FAULTS
+        # plan (cli/serve.py threads one shared plan fleet-wide);
+        # consumes style_encode_error@N (N = Nth encoder dispatch
+        # attempt on this service, 1-based). None = no injection.
     ):
         from speakingstyle_tpu.models.factory import (
             reference_encoder_from_config,
@@ -197,6 +203,12 @@ class StyleService:
             help="reference-encoder device dispatches executed",
         )
 
+        self.fault_plan = fault_plan
+        # style_encode_error@N indexes this 1-based attempt counter; an
+        # int (not itertools.count) so chaos drills can read
+        # ``encode_attempts`` and arm a live plan at the NEXT attempt
+        self._encode_attempts = 0
+        self._attempts_lock = threading.Lock()
         self._capacity = cfg.serve.style.cache_capacity
         self._entries: "OrderedDict[str, StyleVectors]" = OrderedDict()
         self._seq = 0
@@ -231,6 +243,14 @@ class StyleService:
     @property
     def dispatch_count(self) -> int:
         return int(self._dispatches.value)
+
+    @property
+    def encode_attempts(self) -> int:
+        """Encoder dispatch attempts so far (successful or not) — the
+        counter ``style_encode_error@N`` indexes; arm a live plan at
+        ``encode_attempts + 1`` to fault the next attempt."""
+        with self._attempts_lock:
+            return self._encode_attempts
 
     def programs(self) -> List[Dict]:
         """JSON-ready ProgramCards, smallest point first (joins the
@@ -340,6 +360,21 @@ class StyleService:
             self._entries_gauge.set(len(self._entries))
         return entry
 
+    def fallback_style(self) -> StyleVectors:
+        """The default-style FiLM vectors: all-zero (gamma, beta), i.e.
+        the un-modulated decoder — exactly what a model without a
+        reference would produce.  This is what graceful degradation
+        substitutes when the encoder fails (engine._resolve_styles /
+        the HTTP frontend), so the fallback output bit-equals an
+        explicit default-style request.  Never cached: it carries no
+        content address."""
+        return StyleVectors(
+            key="default",
+            gamma=np.zeros((self.d_model,), np.float32),
+            beta=np.zeros((self.d_model,), np.float32),
+            ref_frames=0,
+        )
+
     def styles(self) -> List[Dict]:
         """Registration-ordered metadata of resident styles (the
         ``GET /styles`` payload)."""
@@ -435,9 +470,24 @@ class StyleService:
         chunk_keys: List[str],
     ) -> List[StyleVectors]:
         """One padded encoder dispatch: compile-on-miss (counted, under
-        the lock), pad, execute, read back, insert into the cache."""
+        the lock), pad, execute, read back, insert into the cache.
+
+        A failed encode never poisons the content-addressed cache:
+        ``_insert`` only runs after a successful device round-trip, so
+        every failure path (including the injected one below) leaves the
+        cache exactly as it was and the same key encodes fresh on retry.
+        """
         import jax
 
+        with self._attempts_lock:
+            self._encode_attempts += 1
+            attempt = self._encode_attempts
+        if self.fault_plan is not None and self.fault_plan.fire(
+            "style_encode_error", attempt
+        ):
+            raise InjectedFault(
+                f"injected style_encode_error at encoder dispatch {attempt}"
+            )
         point = self.lattice.cover(len(mels), r)
         with self._compile_lock:
             if point not in self._exe:

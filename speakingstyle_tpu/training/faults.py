@@ -1,96 +1,28 @@
-"""Deterministic fault injection for the resilience layer.
+"""Training-side fault injection.
 
-Every recovery path in training/resilience.py is exercised end-to-end by
-injecting the fault it guards against at an exact, named point.  The
-``SPEAKINGSTYLE_FAULTS`` environment variable holds a spec like
+The ``FaultPlan``/``SPEAKINGSTYLE_FAULTS`` core moved to the shared
+``speakingstyle_tpu.faults`` module when serving grew its own fault
+points (PR 9); this module re-exports it so every training call site —
+trainer, vocoder trainer, ``cli/train.py --faults``, the resilience
+drills — keeps importing from here, and keeps the two faults whose
+*implementation* is training-specific: NaN batch poisoning and real
+SIGTERM delivery.
 
-    loader_ioerror@7;nan_grads@12;sigterm@20
-
-meaning: the 7th feature load raises a (transient) IOError once, the
-batch feeding train step 12 is NaN-poisoned once, and SIGTERM is
-delivered to the process once, right after step 20 completes.  Each
-entry fires exactly once — a retried load or a replayed step after
-rollback does NOT re-trip the same entry, which is what makes recovery
-observable.  Duplicate entries are allowed (``nan_grads@3;nan_grads@3``
-poisons the replay too — how the consecutive-rollback abort is tested).
-
-Counter semantics per kind:
-
-  ``loader_ioerror@N``  Nth call of ``SpeechDataset._feature`` (1-based,
-                        counted per dataset instance)
-  ``nan_grads@N``       the batch consumed by the train step whose
-                        post-increment step counter is N
-  ``sigterm@N``         delivered after step N completes
-
-The plan is plain Python state constructed per run (``FaultPlan.from_env``)
-and threaded explicitly into the sites — no module globals, so tests can
-run many faulted loops in one process.
+See ``speakingstyle_tpu/faults.py`` for the spec grammar and the full
+counter-semantics table (training and serving kinds).
 """
 
-import dataclasses
 import os
 import signal
-from typing import List, Sequence, Tuple
 
-ENV_VAR = "SPEAKINGSTYLE_FAULTS"
-
-KINDS = ("loader_ioerror", "nan_grads", "sigterm")
-
-
-@dataclasses.dataclass
-class _Fault:
-    kind: str
-    at: int
-    fired: bool = False
-
-
-class FaultPlan:
-    """A parsed fault spec; each entry fires at most once."""
-
-    def __init__(self, faults: Sequence[_Fault] = ()):
-        self._faults: List[_Fault] = list(faults)
-
-    @classmethod
-    def parse(cls, spec: str) -> "FaultPlan":
-        faults = []
-        for part in spec.split(";"):
-            part = part.strip()
-            if not part:
-                continue
-            kind, sep, at = part.partition("@")
-            kind = kind.strip()
-            if not sep or kind not in KINDS:
-                raise ValueError(
-                    f"bad fault spec entry {part!r}: expected <kind>@<step> "
-                    f"with kind in {KINDS}"
-                )
-            try:
-                step = int(at)  # jaxlint: disable=JL004
-            except ValueError:
-                raise ValueError(
-                    f"bad fault spec entry {part!r}: step {at!r} is not an int"
-                ) from None
-            faults.append(_Fault(kind, step))
-        return cls(faults)
-
-    @classmethod
-    def from_env(cls) -> "FaultPlan":
-        return cls.parse(os.environ.get(ENV_VAR, ""))
-
-    def __bool__(self) -> bool:
-        return bool(self._faults)
-
-    def fire(self, kind: str, at: int) -> bool:
-        """True exactly once per matching entry when the site's counter
-        hits the named value; False forever after."""
-        for f in self._faults:
-            if f.kind == kind and f.at == at and not f.fired:
-                f.fired = True
-                return True
-        return False
-
-    def pending(self) -> List[Tuple[str, int]]:
-        return [(f.kind, f.at) for f in self._faults if not f.fired]
+from speakingstyle_tpu.faults import (  # noqa: F401  (re-export)
+    ENV_VAR,
+    KINDS,
+    SERVING_KINDS,
+    TRAINING_KINDS,
+    FaultPlan,
+    _Fault,
+)
 
 
 def poison_batch(arrays: dict) -> dict:

@@ -736,6 +736,47 @@ def test_jl012_negative_outside_serving():
 
 
 # ---------------------------------------------------------------------------
+# JL013 — unbounded blocking waits in serving code
+# ---------------------------------------------------------------------------
+
+
+def test_jl013_positive_bare_result_and_get():
+    src = """
+        def serve(future, q):
+            x = future.result()
+            y = q.get()
+            return x, y
+    """
+    details = sorted({
+        f.detail for f in linter.lint_source(
+            textwrap.dedent(src), _SERVING_PATH
+        ) if f.rule == "JL013"
+    })
+    assert len(details) == 2
+
+
+def test_jl013_negative_timeout_and_dict_get():
+    # timeout= (or a positional deadline) bounds the wait; dict.get(key)
+    # carries a positional argument and is not a blocking wait at all
+    assert "JL013" not in _codes("""
+        def serve(future, q, table):
+            x = future.result(timeout=2.5)
+            y = q.get(timeout=0.1)
+            z = future.result(30)
+            return x, y, z, table.get("k"), table.get("k", None)
+    """, path=_SERVING_PATH)
+
+
+def test_jl013_negative_outside_serving():
+    # scoped: a training-side collective or a test helper may block
+    # deliberately (the process has no request deadline to honor)
+    assert "JL013" not in _codes("""
+        def gather(future, q):
+            return future.result(), q.get()
+    """, path="speakingstyle_tpu/training/fake.py")
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -852,7 +893,9 @@ def test_every_rule_is_non_vacuous():
     # AND bounds every serving cache (the StyleService LRU replaced the
     # frontend's unbounded per-path mel dict), so there is nothing to
     # baseline — the desired steady state for preventive rules; their
-    # fixtures above keep them non-vacuous.
+    # fixtures above keep them non-vacuous. JL013 fires on the real tree
+    # via its one baselined hit (the batcher's condition-protected
+    # collect wait), so it is covered by the baseline union below.
     for code in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
                  "JL007", "JL008"):
         assert code in fired, f"{code} never fires on the real tree"
@@ -887,11 +930,12 @@ def test_cli_check_exits_zero_on_repo():
     ("JL011", "import queue\n\nq = queue.Queue()\n"),
     ("JL012", "class F:\n    def __init__(self):\n"
               "        self._mel_cache = {}\n"),
+    ("JL013", "def serve(future):\n    return future.result()\n"),
 ])
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
     # JL004 is scoped to training/ paths; JL007 to speakingstyle_tpu/;
-    # JL011/JL012 to speakingstyle_tpu/serving/
-    sub = "serving" if code in ("JL011", "JL012") else "training"
+    # JL011-JL013 to speakingstyle_tpu/serving/
+    sub = "serving" if code in ("JL011", "JL012", "JL013") else "training"
     d = tmp_path / "speakingstyle_tpu" / sub
     d.mkdir(parents=True)
     f = d / "fixture.py"

@@ -1,0 +1,687 @@
+"""Chaos drills for the serving resilience layer (tier-1).
+
+Four layers, mirroring ARCHITECTURE.md "Serving resilience":
+  1. circuit breaker — the pure state machine (closed/open/half-open,
+     exponential backoff, cap, reset);
+  2. router supervision against fake engines (no jax, millisecond-fast):
+     a replica killed mid-load loses ZERO requests, the hang watchdog
+     steals in-flight work exactly-once (late results discarded),
+     deadlines resolve as DeadlineExceeded even with no replica alive,
+     retry budgets bound transient-failure retries, and a dispatch-loop
+     bookkeeping bug resolves futures as DispatchError without killing
+     the worker (router and single-engine batcher both);
+  3. graceful style degradation on the real tiny model: an injected
+     encoder failure falls back to the default style whose output
+     bit-equals an explicit default-style request, never poisons the
+     content-addressed cache, and surfaces as X-Style-Degraded over
+     HTTP; a vocoder fault aborts the (non-idempotent) stream;
+  4. the fleet chaos acceptance drill: one real replica killed at
+     steady load -> zero lost requests, both replicas READY again, and
+     ZERO steady-state compiles outside the re-warm phase.
+"""
+
+import dataclasses
+import http.client
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from speakingstyle_tpu.configs.config import (
+    Config,
+    FleetConfig,
+    ModelConfig,
+    ReferenceEncoderConfig,
+    ServeConfig,
+    StyleConfig,
+    TransformerConfig,
+    VarianceEmbeddingConfig,
+    VariancePredictorConfig,
+)
+from speakingstyle_tpu.faults import FaultPlan
+from speakingstyle_tpu.obs import MetricsRegistry
+from speakingstyle_tpu.serving.batcher import ContinuousBatcher
+from speakingstyle_tpu.serving.engine import CompileMonitor, SynthesisRequest
+from speakingstyle_tpu.serving.fleet import FAILED, READY, STOPPED, FleetRouter
+from speakingstyle_tpu.serving.lattice import BucketLattice
+from speakingstyle_tpu.serving.resilience import (
+    BREAKER_CODE,
+    CircuitBreaker,
+    DeadlineExceeded,
+    DispatchError,
+    InjectedFault,
+    ReplicaError,
+)
+
+# ---------------------------------------------------------------------------
+# circuit breaker (pure state)
+# ---------------------------------------------------------------------------
+
+
+def test_circuit_breaker_lifecycle_backoff_and_cap():
+    b = CircuitBreaker(0.1, 0.4)
+    assert b.state == "closed" and b.code == BREAKER_CODE["closed"]
+    assert b.record_failure(100.0) == pytest.approx(0.1)
+    assert b.state == "open" and b.consecutive_failures == 1
+    assert not b.ready_to_trial(100.05)     # backoff not elapsed
+    assert b.ready_to_trial(100.1)
+    b.begin_trial()
+    assert b.state == "half_open" and b.code == BREAKER_CODE["half_open"]
+    assert not b.ready_to_trial(500.0)      # half-open is not re-triable
+    # trial failed: re-open with the backoff doubled, then capped
+    assert b.record_failure(200.0) == pytest.approx(0.2)
+    assert b.record_failure(300.0) == pytest.approx(0.4)
+    assert b.record_failure(400.0) == pytest.approx(0.4)  # cap
+    assert b.retry_at() == pytest.approx(400.4)
+    b.begin_trial()
+    b.record_success()                       # first good dispatch: reset
+    assert b.state == "closed" and b.consecutive_failures == 0
+    assert b.record_failure(500.0) == pytest.approx(0.1)  # backoff reset too
+    with pytest.raises(ValueError, match="backoff"):
+        CircuitBreaker(0.0, 1.0)
+    with pytest.raises(ValueError, match="backoff"):
+        CircuitBreaker(1.0, 0.5)
+
+
+# ---------------------------------------------------------------------------
+# router supervision (fake engines — no jax)
+# ---------------------------------------------------------------------------
+
+
+def _fleet_cfg(**fleet_kw):
+    fleet = dict(
+        queue_depth=64, stream_window=8,
+        rewarm_backoff_s=0.05, rewarm_backoff_max_s=1.0,
+        # generous budgets so only the tests that WANT expiry see it
+        class_deadline_ms={"interactive": 10_000.0, "batch": 20_000.0},
+    )
+    fleet.update(fleet_kw)
+    return Config(serve=ServeConfig(
+        batch_buckets=[1], src_buckets=[16], mel_buckets=[64],
+        frames_per_phoneme=2, max_wait_ms=5.0,
+        fleet=FleetConfig(**fleet),
+    ))
+
+
+class _Events:
+    """In-memory stand-in for the JSONL event bus."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.records = []
+
+    def emit(self, event, **fields):
+        with self.lock:
+            self.records.append((event, fields))
+
+    def kinds(self):
+        with self.lock:
+            return [k for k, _ in self.records]
+
+    def of(self, kind):
+        with self.lock:
+            return [dict(f) for k, f in self.records if k == kind]
+
+
+class ChaosEngine:
+    """Fake replica engine recording every dispatched request id; a
+    ``run_hook`` takes over the return value (or raises) when set."""
+
+    def __init__(self, run_hook=None):
+        self.dispatches = []
+        self.lock = threading.Lock()
+        self.run_hook = run_hook
+
+    def precompile(self):
+        return 0.0
+
+    def run(self, requests):
+        with self.lock:
+            self.dispatches.extend(r.id for r in requests)
+        if self.run_hook is not None:
+            return self.run_hook(requests)
+        return [SimpleNamespace(id=r.id, bucket=None, mel_len=1)
+                for r in requests]
+
+
+def _factory(engines, run_hook=None):
+    """Engine factory that keeps building (re-warm calls it again) and
+    records every instance it produced."""
+
+    def build(reg):
+        eng = ChaosEngine(run_hook=run_hook)
+        engines.append(eng)
+        return eng
+
+    return build
+
+
+def _req(i, L=8, T=4, **kw):
+    return SynthesisRequest(
+        id=f"r{i}", sequence=np.ones(L, np.int32),
+        ref_mel=np.zeros((T, 80), np.float32), **kw,
+    )
+
+
+def _wait_states(router, want, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if sorted(router.states().values()) == sorted(want):
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_replica_raise_zero_lost_requests_and_rewarm():
+    """The core chaos invariant against fakes: a replica killed at a
+    deterministic dispatch count loses ZERO requests — its in-flight
+    work requeues onto the healthy replica — and the failed replica
+    circuit-breaks, re-warms, and closes its breaker on the first good
+    dispatch."""
+    engines = []
+    plan = FaultPlan()
+    events = _Events()
+    reg = MetricsRegistry()
+    router = FleetRouter(_factory(engines), _fleet_cfg(), replicas=2,
+                         registry=reg, events=events, fault_plan=plan)
+    assert router.wait_ready(timeout=10, n=2)
+    # phase A: steady load
+    for f in [router.submit(_req(i)) for i in range(4)]:
+        assert f.result(timeout=10) is not None
+    # arm the kill between phases (no dispatches flowing -> no race):
+    # the NEXT dispatch, whoever pops it, raises InjectedFault
+    plan.arm("replica_raise", router.dispatch_total + 1)
+    futs = [router.submit(_req(100 + i)) for i in range(8)]
+    results = [f.result(timeout=10) for f in futs]    # ZERO lost requests
+    assert sorted(r.id for r in results) == sorted(
+        f"r{100 + i}" for i in range(8)
+    )
+    # exactly one replica failed; its one in-flight request was requeued
+    fails = [i for i in (0, 1) if reg.value(
+        "serve_replica_failures_total", {"replica": str(i)}) == 1]
+    assert len(fails) == 1
+    assert reg.value("serve_requeued_total") == 1
+    assert reg.value("serve_retries_total", {"class": "interactive"}) == 1
+    rf = events.of("replica_failure")
+    assert len(rf) == 1
+    assert rf[0]["kind"] == "raise" and rf[0]["error"] == "InjectedFault"
+    assert rf[0]["requeued"] == rf[0]["req_ids"]      # all of it came back
+    # recovery: the failed replica re-warms through cold/warming back to
+    # READY (a third engine build), then its breaker closes on the first
+    # good dispatch it serves
+    assert _wait_states(router, [READY, READY])
+    assert len(engines) == 3
+    idx = str(fails[0])
+    deadline, n = time.monotonic() + 10, 0
+    while (time.monotonic() < deadline and reg.value(
+            "serve_replica_breaker_state", {"replica": idx}) != 0):
+        router.submit(_req(900 + n)).result(timeout=10)
+        n += 1
+    assert reg.value("serve_replica_breaker_state", {"replica": idx}) == 0
+    router.close()
+    assert all(s == STOPPED for s in router.states().values())
+
+
+def test_hang_watchdog_steals_batch_and_discards_late_results():
+    """A dispatch stuck past the hang watchdog is stolen by the
+    supervisor and requeued; when the hung worker eventually finishes,
+    its results are discarded — each future resolves exactly once, from
+    the retry."""
+    engines = []
+    plan = FaultPlan.parse("replica_hang@1")
+    events = _Events()
+    reg = MetricsRegistry()
+    cfg = _fleet_cfg(hang_watchdog_s=0.15)
+    router = FleetRouter(_factory(engines), cfg, replicas=1,
+                         registry=reg, events=events, fault_plan=plan)
+    assert router.wait_ready(timeout=10)
+    fut = router.submit(_req(0))
+    res = fut.result(timeout=10)          # resolved by the retry dispatch
+    assert res.id == "r0"
+    rf = events.of("replica_failure")
+    assert len(rf) == 1 and rf[0]["kind"] == "hang"
+    assert rf[0]["error"] == "TimeoutError"
+    assert reg.value("serve_replica_failures_total", {"replica": "0"}) == 1
+    # the hung worker wakes AFTER the retry resolved, finishes its
+    # dispatch anyway, finds its claim stolen and discards the results
+    deadline = time.monotonic() + 5
+    while (time.monotonic() < deadline
+           and "dispatch_discarded" not in events.kinds()):
+        time.sleep(0.01)
+    assert "dispatch_discarded" in events.kinds()
+    assert sum(e.dispatches.count("r0") for e in engines) == 2
+    router.close()
+
+
+def test_deadline_exceeded_resolves_even_with_no_replica_alive():
+    """Deadline enforcement does not depend on a healthy worker popping
+    the heap: the supervisor sweeps the EDF front, so a request expires
+    as a structured DeadlineExceeded while the only replica is still
+    warming."""
+    gate = threading.Event()
+
+    def factory(reg):
+        gate.wait(timeout=30)
+        return ChaosEngine()
+
+    reg = MetricsRegistry()
+    events = _Events()
+    cfg = _fleet_cfg(class_deadline_ms={"interactive": 60.0,
+                                        "batch": 2000.0})
+    router = FleetRouter(factory, cfg, replicas=1,
+                         registry=reg, events=events)
+    fut = router.submit(_req(0))
+    exc = fut.exception(timeout=5)
+    assert isinstance(exc, DeadlineExceeded)
+    assert exc.klass == "interactive" and exc.budget_ms == 60.0
+    assert "deadline" in str(exc)
+    assert reg.value("serve_deadline_exceeded_total",
+                     {"class": "interactive"}) == 1
+    de = events.of("deadline_exceeded")
+    assert de and de[0]["req_id"] == "r0"
+    gate.set()
+    router.close()
+
+
+def test_retry_budget_exhaustion_resolves_replica_error():
+    """A request burns one retry per replica failure; past the class
+    budget it resolves as ReplicaError (503) instead of looping
+    forever."""
+    engines = []
+    plan = FaultPlan.parse("replica_raise@1;replica_raise@2")
+    reg = MetricsRegistry()
+    cfg = _fleet_cfg(retry_budget={"interactive": 1, "batch": 2})
+    router = FleetRouter(_factory(engines), cfg, replicas=1,
+                         registry=reg, fault_plan=plan)
+    assert router.wait_ready(timeout=10)
+    exc = router.submit(_req(0)).exception(timeout=10)
+    assert isinstance(exc, ReplicaError)
+    assert "retry budget" in str(exc)
+    assert reg.value("serve_requeued_total") == 1
+    assert reg.value("serve_retries_total", {"class": "interactive"}) == 1
+    assert reg.value("serve_replica_failures_total", {"replica": "0"}) == 2
+    router.close()
+
+
+def test_zero_retry_budget_fails_fast():
+    plan = FaultPlan.parse("replica_raise@1")
+    cfg = _fleet_cfg(retry_budget={"interactive": 0, "batch": 0})
+    router = FleetRouter(_factory([]), cfg, replicas=1, fault_plan=plan)
+    assert router.wait_ready(timeout=10)
+    exc = router.submit(_req(0)).exception(timeout=10)
+    assert isinstance(exc, ReplicaError)
+    router.close()
+
+
+def test_fleet_dispatch_bookkeeping_error_keeps_worker_alive():
+    """Satellite: an unexpected exception in the dispatch loop's
+    bookkeeping (the engine call itself succeeded) resolves the affected
+    futures as DispatchError and the worker keeps serving."""
+    calls = {"n": 0}
+
+    def hook(requests):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return None     # buggy engine: run "succeeded", returned junk
+        return [SimpleNamespace(id=r.id, bucket=None, mel_len=1)
+                for r in requests]
+
+    reg = MetricsRegistry()
+    router = FleetRouter(_factory([], run_hook=hook), _fleet_cfg(),
+                         replicas=1, registry=reg)
+    assert router.wait_ready(timeout=10)
+    exc = router.submit(_req(0)).exception(timeout=10)
+    assert isinstance(exc, DispatchError)
+    assert "bookkeeping" in str(exc)
+    assert reg.value("serve_dispatch_errors_total") == 1
+    # NOT a replica failure: the replica stayed READY and still serves
+    assert router.states()[0] == READY
+    assert reg.value("serve_replica_failures_total", {"replica": "0"}) == 0
+    assert router.submit(_req(1)).result(timeout=10).id == "r1"
+    router.close()
+
+
+def test_batcher_dispatch_bookkeeping_error_keeps_worker_alive():
+    """The single-engine batcher carries the same guarantee."""
+    serve = ServeConfig(
+        batch_buckets=[1], src_buckets=[16], mel_buckets=[64],
+        frames_per_phoneme=2, max_wait_ms=5.0, queue_depth=8,
+    )
+    calls = {"n": 0}
+
+    class Eng:
+        def __init__(self):
+            self.cfg = SimpleNamespace(serve=serve)
+            self.lattice = BucketLattice.from_config(serve)
+
+        def admit(self, request):
+            self.lattice.cover(1, len(request.sequence), 1)
+
+        def run(self, requests):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                return None
+            return [SimpleNamespace(id=r.id, bucket=None)
+                    for r in requests]
+
+    b = ContinuousBatcher(Eng())
+    exc = b.submit(_req(0, T=1)).exception(timeout=10)
+    assert isinstance(exc, DispatchError)
+    assert b.submit(_req(1, T=1)).result(timeout=10).id == "r1"
+    assert b.registry.value("serve_dispatch_errors_total") == 1
+    b.close()
+
+
+def test_stream_continuation_lost_replica_is_not_retried():
+    """Stream continuations are non-idempotent: a result whose replica
+    failed raises ReplicaError at the next chunk instead of silently
+    re-dispatching on another replica."""
+    engines = []
+    plan = FaultPlan()
+    cfg = _fleet_cfg(retry_budget={"interactive": 0, "batch": 0},
+                     rewarm_backoff_s=30.0, rewarm_backoff_max_s=60.0)
+    router = FleetRouter(_factory(engines), cfg, replicas=1,
+                         fault_plan=plan)
+    assert router.wait_ready(timeout=10)
+    res = router.submit(_req(0)).result(timeout=10)
+    assert res.replica == 0
+    plan.arm("replica_raise", router.dispatch_total + 1)
+    exc = router.submit(_req(1)).exception(timeout=10)
+    assert isinstance(exc, ReplicaError)
+    assert router.states()[0] == FAILED    # 30 s backoff: stays failed
+    with pytest.raises(ReplicaError, match="not retried"):
+        next(router.stream(res))
+    router.close()
+
+
+# ---------------------------------------------------------------------------
+# graceful style degradation + stream faults (tiny real model)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_cfg():
+    return Config(
+        model=ModelConfig(
+            transformer=TransformerConfig(
+                encoder_layer=1, decoder_layer=1, encoder_hidden=16,
+                decoder_hidden=16, conv_filter_size=16,
+                conv_kernel_size=(3, 1),
+            ),
+            reference_encoder=ReferenceEncoderConfig(
+                encoder_layer=1, encoder_head=2, encoder_hidden=16,
+                conv_layer=1, conv_filter_size=16,
+            ),
+            variance_predictor=VariancePredictorConfig(filter_size=16),
+            variance_embedding=VarianceEmbeddingConfig(n_bins=8),
+            postnet_embedding_dim=16, postnet_layers=2,
+            max_seq_len=48, compute_dtype="float32",
+        ),
+        serve=ServeConfig(
+            batch_buckets=[1, 2], src_buckets=[16], mel_buckets=[32],
+            frames_per_phoneme=2, max_wait_ms=20.0,
+            fleet=FleetConfig(
+                stream_window=8, queue_depth=64,
+                rewarm_backoff_s=0.05, rewarm_backoff_max_s=1.0,
+                class_deadline_ms={"interactive": 60_000.0,
+                                   "batch": 120_000.0},
+            ),
+            style=StyleConfig(ref_buckets=[32]),
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_parts():
+    """Model/weights/vocoder built once; engines are constructed per
+    test/replica from these (test_fleet.py's convention)."""
+    import jax
+
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.models.hifigan import Generator
+
+    cfg = _tiny_cfg()
+    model = build_model(cfg, n_position=49)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    bias = variables["params"]["variance_adaptor"]["duration_predictor"][
+        "linear_layer"]["bias"]
+    variables["params"]["variance_adaptor"]["duration_predictor"][
+        "linear_layer"]["bias"] = bias + 1.1
+    gen = Generator(
+        upsample_rates=(2, 2), upsample_kernel_sizes=(4, 4),
+        upsample_initial_channel=16, resblock_kernel_sizes=(3,),
+        resblock_dilation_sizes=((1,),),
+    )
+    gparams = gen.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8, 80), np.float32)
+    )["params"]
+    return cfg, model, variables, gen, gparams
+
+
+@pytest.fixture(scope="module")
+def chaos_engine(tiny_parts):
+    """One precompiled tiny engine with a LIVE (initially empty) fault
+    plan attached: tests arm entries at the next counter value."""
+    from speakingstyle_tpu.serving.engine import SynthesisEngine
+
+    cfg, model, variables, gen, gparams = tiny_parts
+    plan = FaultPlan()
+    engine = SynthesisEngine(cfg, variables, vocoder=(gen, gparams),
+                             model=model, fault_plan=plan)
+    engine.precompile()
+    return engine, plan
+
+
+def _mkreq(i, L=10, T=20, **kw):
+    rng = np.random.default_rng(i)
+    kw.setdefault(
+        "ref_mel", rng.standard_normal((T, 80)).astype(np.float32)
+    )
+    return SynthesisRequest(
+        id=f"utt{i}",
+        sequence=rng.integers(1, 300, L).astype(np.int32),
+        **kw,
+    )
+
+
+def test_style_degradation_parity_and_cache_unpoisoned(chaos_engine):
+    """Acceptance: an injected encoder failure degrades to the default
+    style — whose output bit-equals an explicit default-style request —
+    never reaches the content-addressed cache, and the same reference
+    encodes fresh (undegraded) on its next request."""
+    engine, plan = chaos_engine
+    style = engine.style
+    n0 = len(style)
+    failures0 = engine.registry.value(
+        "serve_style_encode_failures_total", {"error": "InjectedFault"})
+    plan.arm("style_encode_error", style.encode_attempts + 1)
+    degraded = engine.run([_mkreq(50)])[0]
+    assert degraded.style_degraded is True
+    assert len(style) == n0                 # failed encode never cached
+    assert engine.registry.value("serve_style_degraded_total") == 1
+    assert engine.registry.value(
+        "serve_style_encode_failures_total", {"error": "InjectedFault"}
+    ) == failures0 + 1
+    # parity: bit-equal to an explicit default-style request
+    explicit = engine.run(
+        [_mkreq(50, style=style.fallback_style(), ref_mel=None)]
+    )[0]
+    assert explicit.style_degraded is False
+    np.testing.assert_array_equal(degraded.mel, explicit.mel)
+    np.testing.assert_array_equal(degraded.wav, explicit.wav)
+    # un-poisoned: the same reference encodes fresh next time, lands in
+    # the cache, and produces a genuinely different (styled) output
+    fresh = engine.run([_mkreq(50)])[0]
+    assert fresh.style_degraded is False
+    assert len(style) == n0 + 1
+    sv = style.get(style.digest_mel(_mkreq(50).ref_mel))
+    assert sv is not None
+    assert np.any(sv.gamma != 0) or np.any(sv.beta != 0)
+
+
+def test_vocoder_fault_aborts_stream(chaos_engine):
+    """A vocoder fault mid-stream raises (the chunked body truncates);
+    stream continuations are never transparently retried."""
+    from speakingstyle_tpu.serving import streaming
+
+    engine, plan = chaos_engine
+    res = engine.run([_mkreq(60, stream=True)])[0]
+    gen, _ = engine.vocoder
+    window = engine.cfg.serve.fleet.stream_window
+    overlap = streaming.resolve_overlap(
+        engine.cfg.serve.fleet.stream_overlap, gen
+    )
+    plan.arm("vocoder_raise", engine.vocode_calls + 1)
+    with pytest.raises(InjectedFault, match="vocoder_raise"):
+        list(streaming.stream_wav(engine, res, window, overlap))
+    # the fault was one-shot: the same stream replays clean after it
+    chunks = list(streaming.stream_wav(engine, res, window, overlap))
+    assert sum(len(c) for c in chunks) == res.mel_len * gen.hop_factor
+
+
+def test_http_style_degraded_header(chaos_engine):
+    """HTTP surface of degradation: the frontend's encoder failure
+    produces a 200 with X-Style-Degraded: 1; the next request (cache
+    still unpoisoned) encodes fine and carries no header."""
+    from speakingstyle_tpu.serving.server import SynthesisServer, TextFrontend
+
+    engine, plan = chaos_engine
+    ref = np.random.default_rng(7).standard_normal((20, 80)).astype(
+        np.float32)
+    server = SynthesisServer(
+        engine, TextFrontend(engine.cfg, ref), host="127.0.0.1", port=0,
+    )
+    host, port = server.address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=120)
+        plan.arm("style_encode_error", engine.style.encode_attempts + 1)
+        payload = json.dumps({"text": "style me"})
+        conn.request("POST", "/synthesize", body=payload)
+        resp = conn.getresponse()
+        degraded_wav = resp.read()
+        assert resp.status == 200
+        assert resp.getheader("X-Style-Degraded") == "1"
+        assert degraded_wav[:4] == b"RIFF"
+        conn.request("POST", "/synthesize", body=payload)
+        resp = conn.getresponse()
+        ok_wav = resp.read()
+        assert resp.status == 200
+        assert resp.getheader("X-Style-Degraded") is None
+        assert ok_wav[:4] == b"RIFF"
+        conn.close()
+    finally:
+        server.shutdown()
+
+
+def test_http_504_on_deadline_with_structured_body():
+    """Satellite: the handler's future wait is bounded by the class
+    deadline budget (+grace) and a deadline expiry maps to 504 — here
+    while the only replica never finishes warming (no jax involved)."""
+    from speakingstyle_tpu.serving.server import SynthesisServer, TextFrontend
+
+    gate = threading.Event()
+
+    def factory(reg):
+        gate.wait(timeout=60)
+        return ChaosEngine()
+
+    cfg = _fleet_cfg(
+        class_deadline_ms={"interactive": 100.0, "batch": 2000.0},
+        deadline_grace_ms=400.0,
+    )
+    router = FleetRouter(factory, cfg, replicas=1,
+                         registry=MetricsRegistry())
+    server = SynthesisServer(
+        frontend=TextFrontend(cfg, np.zeros((4, 80), np.float32)),
+        host="127.0.0.1", port=0, router=router,
+    )
+    host, port = server.address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    try:
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        t0 = time.monotonic()
+        conn.request("POST", "/synthesize",
+                     body=json.dumps({"text": "too late"}))
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 504
+        assert "deadline" in body["error"]
+        # resolved by the budget, not by the 60 s default request timeout
+        assert time.monotonic() - t0 < 5.0
+        conn.close()
+    finally:
+        gate.set()
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fleet chaos acceptance drill (tiny real model)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_chaos_recovery_zero_lost_zero_steady_compiles(tiny_parts):
+    """The acceptance drill at fleet scale: one of two real replicas is
+    killed at a deterministic dispatch count under load — zero requests
+    are lost, the fleet recovers to two READY replicas, and once the
+    re-warm phase is over steady-state serving performs ZERO compiles."""
+    from speakingstyle_tpu.serving.engine import SynthesisEngine
+
+    cfg, model, variables, gen, gparams = tiny_parts
+    reg = MetricsRegistry()
+    plan = FaultPlan()
+
+    def factory(registry):
+        return SynthesisEngine(cfg, variables, vocoder=(gen, gparams),
+                               model=model, registry=registry)
+
+    with FleetRouter(factory, cfg, replicas=2, registry=reg,
+                     fault_plan=plan) as router:
+        assert router.wait_ready(timeout=300, n=2)
+        for engine in router.engines():
+            for b in engine.lattice.batch_buckets:
+                engine.run([_mkreq(700 + b * 10 + j) for j in range(b)])
+        # steady phase A
+        for f in [router.submit(_mkreq(i)) for i in range(4)]:
+            assert f.result(timeout=120).wav is not None
+        # kill one replica on the next dispatch (armed between phases)
+        plan.arm("replica_raise", router.dispatch_total + 1)
+        futs = [router.submit(_mkreq(10 + i)) for i in range(6)]
+        results = [f.result(timeout=120) for f in futs]  # ZERO lost
+        assert sorted(r.id for r in results) == sorted(
+            f"utt{10 + i}" for i in range(6)
+        )
+        fails = [i for i in (0, 1) if reg.value(
+            "serve_replica_failures_total", {"replica": str(i)}) == 1]
+        assert len(fails) == 1
+        # recovery: the failed replica re-warms (recompiling — excluded
+        # from the steady-state monitor below) back to READY
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            if sorted(router.states().values()) == [READY, READY]:
+                break
+            time.sleep(0.05)
+        assert sorted(router.states().values()) == [READY, READY]
+        # re-warmed engine: first-execution transfer warmup per bucket
+        for engine in router.engines():
+            for b in engine.lattice.batch_buckets:
+                engine.run([_mkreq(800 + b * 10 + j) for j in range(b)])
+        # drive dispatches until the re-warmed replica served one (its
+        # breaker closes there)
+        idx, n = str(fails[0]), 0
+        deadline = time.monotonic() + 120
+        while (time.monotonic() < deadline and reg.value(
+                "serve_replica_breaker_state", {"replica": idx}) != 0):
+            router.submit(_mkreq(2000 + n)).result(timeout=120)
+            n += 1
+        assert reg.value(
+            "serve_replica_breaker_state", {"replica": idx}) == 0
+        # steady phase B: the post-recovery fleet compiles NOTHING
+        with CompileMonitor() as mon:
+            futs = [router.submit(_mkreq(100 + i)) for i in range(6)]
+            for i, f in enumerate(futs):
+                assert f.result(timeout=120).id == f"utt{100 + i}"
+        assert mon.count == 0, "the fleet compiled after re-warm"
+        assert reg.value("serve_requeued_total") >= 1
