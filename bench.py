@@ -1509,6 +1509,171 @@ def run_ab():
         print(line or json.dumps({"error": proc.stderr[-300:], "overrides": ov}))
 
 
+# ---------------------------------------------------------------------------
+# --multichip: DP scaling sweep on the virtual-device CPU proxy
+# ---------------------------------------------------------------------------
+
+MULTICHIP_DEVICE_COUNTS = (1, 2, 4, 8)
+# weak scaling: fixed per-chip batch, so frames/s/chip should hold roughly
+# flat as the mesh grows; the 1-device point is the normalizer. Tiny model
+# (test_parallel.py scale) — the sweep measures the mesh machinery (GSPMD
+# partitioning + collectives overhead), not kernel throughput, and the CPU
+# proxy could not say anything about kernel speed anyway.
+MULTICHIP_B_PER_CHIP, MULTICHIP_L, MULTICHIP_T = 4, 32, 64
+MULTICHIP_WARMUP, MULTICHIP_STEPS = 3, 10
+
+
+def _multichip_child(n_devices: int):
+    """One sweep point; runs in a child process whose XLA_FLAGS carry
+    --xla_force_host_platform_device_count={n}. Tiny FastSpeech2, DP mesh
+    over all n virtual devices, fixed per-chip batch, timed jitted steps
+    through the production make_train_step. Emits ONE JSON line."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from speakingstyle_tpu.configs.config import (
+        Config,
+        ModelConfig,
+        ReferenceEncoderConfig,
+        TransformerConfig,
+        VariancePredictorConfig,
+    )
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.parallel.mesh import make_mesh
+    from speakingstyle_tpu.training.optim import make_optimizer
+    from speakingstyle_tpu.training.state import TrainState
+    from speakingstyle_tpu.training.trainer import make_train_step
+
+    if len(jax.devices()) < n_devices:
+        print(json.dumps({
+            "metric": "train_multichip", "n_devices": n_devices,
+            "frames_per_sec": None,
+            "error": f"only {len(jax.devices())} devices visible",
+        }))
+        return
+    cfg = Config(
+        model=ModelConfig(
+            transformer=TransformerConfig(
+                encoder_layer=1, decoder_layer=1,
+                encoder_hidden=16, decoder_hidden=16,
+                encoder_head=2, decoder_head=2,
+                conv_filter_size=32,
+            ),
+            reference_encoder=ReferenceEncoderConfig(
+                encoder_layer=1, conv_layer=1, encoder_hidden=16,
+                encoder_head=2, conv_filter_size=16,
+            ),
+            variance_predictor=VariancePredictorConfig(filter_size=16),
+            compute_dtype="float32",
+        )
+    )
+    mesh = (
+        make_mesh(data=n_devices, model=1, devices=jax.devices()[:n_devices])
+        if n_devices > 1
+        else None  # the production 1x1 path: no mesh at all
+    )
+    Bn, L, T = MULTICHIP_B_PER_CHIP * n_devices, MULTICHIP_L, MULTICHIP_T
+    rng_np = np.random.default_rng(0)
+    batch = dict(
+        speakers=jnp.zeros((Bn,), jnp.int32),
+        texts=jnp.asarray(rng_np.integers(1, 300, (Bn, L)), jnp.int32),
+        src_lens=jnp.full((Bn,), L, jnp.int32),
+        mels=jnp.asarray(rng_np.standard_normal((Bn, T, 80)), jnp.float32),
+        mel_lens=jnp.full((Bn,), T, jnp.int32),
+        pitches=jnp.asarray(rng_np.standard_normal((Bn, L)), jnp.float32),
+        energies=jnp.asarray(rng_np.standard_normal((Bn, L)), jnp.float32),
+        durations=jnp.full((Bn, L), T // L, jnp.int32),
+    )
+    if mesh is not None:
+        batch = {
+            k: jax.device_put(v, NamedSharding(mesh, P("data")))
+            for k, v in batch.items()
+        }
+    model = build_model(cfg)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    tx = make_optimizer(cfg.train)
+    state = TrainState.create(variables, tx)
+    if mesh is not None:
+        state = jax.device_put(state, NamedSharding(mesh, P()))
+    step = make_train_step(model, tx, cfg, mesh=mesh, state_shardings=None)
+    rng = jax.random.PRNGKey(1)
+    # the step folds in state.step (trainer.py), so one key is correct here
+    for _ in range(MULTICHIP_WARMUP):
+        state, losses = step(state, batch, rng)  # jaxlint: disable=JL006
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for _ in range(MULTICHIP_STEPS):
+        state, losses = step(state, batch, rng)  # jaxlint: disable=JL006
+    jax.block_until_ready((state, losses))
+    dt = time.perf_counter() - t0
+    fps = Bn * T * MULTICHIP_STEPS / dt
+    print(json.dumps({
+        "metric": "train_multichip",
+        "n_devices": n_devices,
+        "mesh": [n_devices, 1],
+        "batch": Bn,
+        "steps": MULTICHIP_STEPS,
+        "frames_per_sec": fps,
+        "frames_per_sec_per_chip": fps / n_devices,
+        "platform": "cpu-proxy",
+    }))
+
+
+def run_multichip(device_counts=MULTICHIP_DEVICE_COUNTS):
+    """The --multichip scaling sweep: one child process per device count,
+    each with ``--xla_force_host_platform_device_count={n}`` (the flag only
+    takes effect before the backend initializes, hence the re-exec), fixed
+    per-chip batch. Prints one JSON line per point; the recorded
+    MULTICHIP_r*.json rides `--compare` as multichip_frames_per_s_per_chip_{n}d."""
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for n in device_counts:
+        env = dict(os.environ)
+        # CPU proxy on purpose: virtual devices exercise the GSPMD
+        # partitioner + collectives exactly like real chips; absolute
+        # numbers are meaningless, the per-chip RATIO is the metric
+        env["JAX_PLATFORMS"] = "cpu"
+        # a pallas-axon pool in the env would capture the children
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            env.get("XLA_FLAGS", ""),
+        ).strip()
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n}".strip()
+        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--multichip-inner", "--n-devices", str(n)],
+                capture_output=True,
+                text=True,
+                timeout=600,
+                env=env,
+                cwd=here,
+            )
+        except subprocess.TimeoutExpired:
+            print(json.dumps({
+                "metric": "train_multichip", "n_devices": n,
+                "frames_per_sec": None, "error": "timeout after 600s",
+            }))
+            continue
+        line = next(
+            (ln for ln in reversed(proc.stdout.strip().splitlines())
+             if ln.startswith("{")),
+            None,
+        )
+        print(line or json.dumps({
+            "metric": "train_multichip", "n_devices": n,
+            "frames_per_sec": None,
+            "error": f"rc={proc.returncode}: {proc.stderr[-300:]}",
+        }))
+
+
 REGRESSION_THRESHOLD = 0.10
 
 
@@ -1557,6 +1722,11 @@ def _absorb_record(rec, metrics):
                                               "lower")
         if isinstance(rec.get("shed"), (int, float)):
             metrics["chaos_shed"] = (float(rec["shed"]), "lower")
+    elif m == "train_multichip":
+        n = rec.get("n_devices")
+        if isinstance(rec.get("frames_per_sec_per_chip"), (int, float)):
+            metrics[f"multichip_frames_per_s_per_chip_{n}d"] = (
+                float(rec["frames_per_sec_per_chip"]), "higher")
     elif m == "serve_style_cache_qps_gain":
         if isinstance(rec.get("value"), (int, float)):
             metrics[m] = (float(rec["value"]), "higher")
@@ -1776,6 +1946,10 @@ if __name__ == "__main__":
         run_style(duration=dur)
     elif "--ab" in sys.argv:
         run_ab()
+    elif "--multichip-inner" in sys.argv:
+        _multichip_child(int(sys.argv[sys.argv.index("--n-devices") + 1]))
+    elif "--multichip" in sys.argv:
+        run_multichip()
     elif "--compare" in sys.argv:
         i = sys.argv.index("--compare")
         rest = [a for a in sys.argv[i + 1:] if not a.startswith("--")]

@@ -45,6 +45,12 @@ JL013  unbounded blocking waits in serving code: ``.result()`` or a
        on a future/queue survives the very replica failure the
        supervision layer exists to detect; every serving wait needs a
        deadline so a fault resolves as a structured 5xx, not a hang
+JL014  hard single-device pinning in training/data code:
+       ``device_put(x, jax.devices()[0])`` (or ``jax.local_devices()``,
+       directly or via a variable) under training/ or data/ — now that
+       the trainer runs on a mesh, placement is a sharding contract;
+       a pin to device 0 funnels every batch onto one chip of the mesh
+       (correct but 1/N throughput). Pass a NamedSharding instead.
 """
 
 import ast
@@ -1600,6 +1606,89 @@ def rule_jl013(mod: ModuleInfo) -> Iterator[Finding]:
         )
 
 
+# ---------------------------------------------------------------------------
+# JL014 — hard single-device pinning in training/data code
+# ---------------------------------------------------------------------------
+
+
+_DEVICE_LIST_CALLS = ("jax.devices", "jax.local_devices")
+
+
+def _device_pin_spelling(node: ast.AST, pinned_names: Set[str]) -> str:
+    """The pinned-device spelling if ``node`` hard-pins one device
+    (``jax.devices()[i]`` / ``jax.local_devices()[i]``, or a name
+    assigned from one), else ''."""
+    if isinstance(node, ast.Subscript):
+        base = _dotted(node.value)
+        if base in _DEVICE_LIST_CALLS:
+            return f"{base}()[...]"
+    if isinstance(node, ast.Name) and node.id in pinned_names:
+        return node.id
+    return ""
+
+
+def rule_jl014(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL014: hard single-device pinning under ``training/`` or ``data/``:
+    ``jax.device_put(x, jax.devices()[0])`` — the device argument is a
+    subscript of ``jax.devices()``/``jax.local_devices()``, directly or
+    through a variable assigned from one.
+
+    Now that the trainer runs on a mesh, placement is a *sharding*
+    contract: the prefetcher device_puts against the batch
+    NamedSharding, the state is laid out by train_state_shardings, and
+    XLA spreads both across the mesh. A device_put pinned to device 0
+    silently defeats that — every batch (and the compute consuming it)
+    funnels onto one chip of an N-chip mesh, so the run stays correct
+    while throughput divides by N. Pass the mesh's NamedSharding
+    (``batch_sharding(mesh)``) instead, or omit the device and let jax
+    place single-chip transfers by default.
+    """
+    p = mod.path.replace("\\", "/")
+    if "training/" not in p and "data/" not in p:
+        return
+    # names assigned (lexically, anywhere in the file) from a
+    # jax.devices()/jax.local_devices() subscript
+    pinned: Set[str] = set()
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Subscript
+        ):
+            if _dotted(node.value.value) in _DEVICE_LIST_CALLS:
+                pinned |= {
+                    t.id for t in node.targets if isinstance(t, ast.Name)
+                }
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func)
+        if callee not in ("jax.device_put", "device_put"):
+            continue
+        dev_args = list(node.args[1:]) + [
+            kw.value for kw in node.keywords if kw.arg == "device"
+        ]
+        for arg in dev_args:
+            pin = _device_pin_spelling(arg, pinned)
+            if not pin:
+                continue
+            fn = mod.enclosing_function(node)
+            qual = mod.qualname(fn or mod.tree)
+            yield Finding(
+                rule="JL014",
+                path=mod.path,
+                line=node.lineno,
+                context=qual,
+                detail=f"device_put pinned to {pin}",
+                message=(
+                    f"`device_put(..., {pin})` in {qual} hard-pins the "
+                    "transfer to one device: under a mesh this funnels "
+                    "every batch onto a single chip (1/N throughput). "
+                    "Pass the mesh's NamedSharding "
+                    "(batch_sharding(mesh)) or omit the device."
+                ),
+            )
+            break
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -1614,4 +1703,5 @@ RULES = {
     "JL011": rule_jl011,
     "JL012": rule_jl012,
     "JL013": rule_jl013,
+    "JL014": rule_jl014,
 }

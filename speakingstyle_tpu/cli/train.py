@@ -18,12 +18,15 @@ def build_parser(parser=None):
     )
     parser.add_argument(
         "--data_parallel", type=int, default=None,
-        help="data-axis size for the device mesh (default: all local devices)",
+        help="data-axis size for the device mesh; overrides "
+        "train.parallel.mesh (default: the train.parallel.* config block, "
+        "falling back to the legacy train.sharding derivation)",
     )
     parser.add_argument(
         "--model_parallel", type=int, default=None,
-        help="tensor-parallel degree over the mesh's model axis "
-        "(default: train.sharding.model_axis from the config)",
+        help="tensor-parallel degree over the mesh's model axis; overrides "
+        "train.parallel.mesh (default: the train.parallel.* config block, "
+        "falling back to train.sharding.model_axis)",
     )
     parser.add_argument(
         "--synth", action="store_true",
@@ -71,7 +74,7 @@ def main(args):
         jax.distributed.initialize()
     import jax
 
-    from speakingstyle_tpu.parallel.mesh import make_mesh
+    from speakingstyle_tpu.parallel.mesh import make_mesh, resolve_mesh
     from speakingstyle_tpu.training.trainer import run_training
 
     cfg = config_from_args(args)
@@ -81,28 +84,40 @@ def main(args):
         from speakingstyle_tpu.obs import enable_compilation_cache
 
         enable_compilation_cache(cfg.train.obs.compilation_cache_dir)
-    model_axis = (
-        args.model_parallel
-        if args.model_parallel is not None
-        else cfg.train.sharding.model_axis
-    )
-    n_total = len(jax.devices())
-    if args.data_parallel:
-        data_axis = args.data_parallel
-    elif cfg.train.sharding.data_axis > 0:
-        data_axis = cfg.train.sharding.data_axis
+    par = cfg.train.parallel
+    flags_given = args.data_parallel is not None or args.model_parallel is not None
+    if not par.is_single() and not flags_given:
+        # train.parallel.* is the multichip contract: mesh != [1,1]
+        # engages the mesh path; [1,1] leaves mesh=None (the single-chip
+        # path, byte-for-byte the old behavior). Batch divisibility and
+        # device-count fit are validated at startup (BatchShardingError /
+        # ValueError name the fix).
+        mesh = resolve_mesh(par)
     else:
-        data_axis = n_total // model_axis
-    n_dev = data_axis * model_axis
-    mesh = (
-        make_mesh(
-            data=data_axis,
-            model=model_axis,
-            devices=jax.devices()[:n_dev],
+        # legacy resolution, unchanged: CLI flags win, then the
+        # train.sharding block, then all-device DP
+        model_axis = (
+            args.model_parallel
+            if args.model_parallel is not None
+            else cfg.train.sharding.model_axis
         )
-        if n_dev > 1
-        else None
-    )
+        n_total = len(jax.devices())
+        if args.data_parallel:
+            data_axis = args.data_parallel
+        elif cfg.train.sharding.data_axis > 0:
+            data_axis = cfg.train.sharding.data_axis
+        else:
+            data_axis = n_total // model_axis
+        n_dev = data_axis * model_axis
+        mesh = (
+            make_mesh(
+                data=data_axis,
+                model=model_axis,
+                devices=jax.devices()[:n_dev],
+            )
+            if n_dev > 1
+            else None
+        )
     vocoder = None
     if args.synth and args.vocoder_ckpt:
         from speakingstyle_tpu.synthesis import get_vocoder

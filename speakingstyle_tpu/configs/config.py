@@ -304,11 +304,93 @@ class TrainPathConfig:
 
 @dataclass(frozen=True)
 class ShardingConfig:
-    """TPU mesh layout (no reference counterpart; replaces nn.DataParallel)."""
+    """TPU mesh layout (no reference counterpart; replaces nn.DataParallel).
+
+    Legacy block: ``train.parallel`` (ParallelConfig) is the multichip
+    contract now; this survives for old YAML and the
+    ``--data_parallel``/``--model_parallel`` CLI flags, which map onto the
+    same mesh resolution in ``cli/train.py``."""
 
     data_axis: int = -1  # -1: all devices on the data axis
     model_axis: int = 1  # tensor-parallel degree (1 = pure DP)
     remat: bool = False  # jax.checkpoint the FFT stacks
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    """Multichip mesh layout for the production trainer
+    (``parallel/mesh.py`` / ``parallel/partition.py`` — ARCHITECTURE.md
+    "Multichip training").
+
+    ``mesh = [dp, tp]`` names the 2-D device mesh: batches shard over the
+    ``data`` axis (dp-way), parameters shard over the ``model`` axis
+    (tp-way, Megatron-style column/row rules). The default ``[1, 1]`` is
+    the single-chip path — ``resolve_mesh`` returns ``None`` and the
+    trainer behaves exactly as before. ``dp = -1`` consumes all devices
+    not claimed by ``tp``.
+    """
+
+    # [dp, tp]: data-parallel x tensor-parallel degree. [1, 1] = single
+    # chip (mesh path disengaged); dp = -1 = all remaining devices
+    mesh: List[int] = field(default_factory=lambda: [1, 1])
+    # sequence-parallel axis for ring attention (long-context training);
+    # 1 = off. Engages attention_impl="ring" semantics; the mesh then
+    # needs dp*tp*seq devices.
+    seq: int = 1
+    # partition-rule overrides PREPENDED to DEFAULT_TP_RULES (first match
+    # wins): each entry is [path_regex, axes] where axes is a
+    # comma-separated per-dim list of mesh axis names or "none", e.g.
+    # ["encoder_emb/embedding$", "none,model"] -> P(None, "model")
+    partition_rules: List[List[str]] = field(default_factory=list)
+
+    def __post_init__(self):
+        if len(self.mesh) != 2:
+            raise ValueError(
+                f"train.parallel.mesh must be [dp, tp], got {self.mesh}"
+            )
+        dp, tp = self.mesh
+        if tp < 1:
+            raise ValueError(f"train.parallel.mesh tp must be >= 1, got {tp}")
+        if dp < 1 and dp != -1:
+            raise ValueError(
+                f"train.parallel.mesh dp must be >= 1 (or -1 for all "
+                f"remaining devices), got {dp}"
+            )
+        if self.seq < 1:
+            raise ValueError(f"train.parallel.seq must be >= 1, got {self.seq}")
+        import re as _re
+
+        for rule in self.partition_rules:
+            if len(rule) != 2 or not all(isinstance(s, str) for s in rule):
+                raise ValueError(
+                    "train.parallel.partition_rules entries must be "
+                    f"[path_regex, axes] string pairs, got {rule!r}"
+                )
+            pattern, axes = rule
+            try:
+                _re.compile(pattern)
+            except _re.error as e:
+                raise ValueError(
+                    f"train.parallel.partition_rules regex {pattern!r}: {e}"
+                )
+            for tok in axes.split(","):
+                if tok.strip().lower() not in ("", "none", "data", "model", "seq"):
+                    raise ValueError(
+                        f"train.parallel.partition_rules axes token {tok!r} "
+                        "must be one of none|data|model|seq"
+                    )
+
+    @property
+    def dp(self) -> int:
+        return self.mesh[0]
+
+    @property
+    def tp(self) -> int:
+        return self.mesh[1]
+
+    def is_single(self) -> bool:
+        """True iff this config keeps the single-chip train path."""
+        return tuple(self.mesh) == (1, 1) and self.seq == 1
 
 
 @dataclass(frozen=True)
@@ -402,6 +484,7 @@ class TrainConfig:
     step: StepConfig = field(default_factory=StepConfig)
     loss: LossConfig = field(default_factory=LossConfig)
     sharding: ShardingConfig = field(default_factory=ShardingConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
     resilience: ResilienceConfig = field(default_factory=ResilienceConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     ignore_layers: List[str] = field(default_factory=list)

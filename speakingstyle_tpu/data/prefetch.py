@@ -101,13 +101,28 @@ class DevicePrefetcher:
                 # batch (same dataset + seed => same shuffle), and each
                 # host materializes only its addressable shards. XLA then
                 # treats the result as one global array over the pod mesh.
-                arrays = {
-                    k: jax.make_array_from_callback(
-                        v.shape, self.sharding, lambda idx, v=v: v[idx]
-                    )
-                    for k, v in arrays.items()
-                }
+                # make_array_from_process_local_data slices the local data
+                # per the sharding itself; the callback spelling is the
+                # fallback for jax builds that predate it.
+                make = getattr(jax, "make_array_from_process_local_data", None)
+                if make is not None:
+                    # global_shape == local shape tells it each process
+                    # holds the FULL batch; it slices the addressable rows
+                    arrays = {
+                        k: make(self.sharding, v, global_shape=v.shape)
+                        for k, v in arrays.items()
+                    }
+                else:
+                    arrays = {
+                        k: jax.make_array_from_callback(
+                            v.shape, self.sharding, lambda idx, v=v: v[idx]
+                        )
+                        for k, v in arrays.items()
+                    }
             else:
+                # single-process: one device_put against the batch
+                # NamedSharding (never a hard-pinned device — jaxlint
+                # JL014 guards that under training/ and data/)
                 arrays = {
                     k: jax.device_put(v, self.sharding)
                     for k, v in arrays.items()

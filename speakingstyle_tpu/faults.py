@@ -127,3 +127,23 @@ class FaultPlan:
     def pending(self) -> List[Tuple[str, int]]:
         with self._lock:
             return [(f.kind, f.at) for f in self._faults if not f.fired]
+
+
+def dp_poison_rows(batch_rows: int, dp: int) -> int:
+    """The ``nan_grads``-under-DP drill: how many leading batch rows to
+    poison so the NaN lands on exactly ONE data-parallel shard.
+
+    A ``data``-sharded batch of ``batch_rows`` rows over a ``dp``-way mesh
+    gives each shard ``batch_rows // dp`` contiguous rows; poisoning just
+    the first shard's slice makes the drill adversarial — the sentinel's
+    ``_finite`` flag is only safe if its dp-axis all-reduce makes every
+    device (and every host) see the one bad shard.  Returns the full batch
+    when it cannot be split (dp <= 1 or fewer rows than shards): the
+    single-chip drill poisons everything, as before.
+
+    Pure host arithmetic (no jax) so serving-side imports of this module
+    stay device-free; ``training/faults.py::poison_batch`` applies it.
+    """
+    if dp <= 1 or batch_rows < dp:
+        return batch_rows
+    return batch_rows // dp

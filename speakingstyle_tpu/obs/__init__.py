@@ -26,6 +26,7 @@ from speakingstyle_tpu.obs.cost import (
     FLOPS_PER_SEC_BUCKETS,
     ProgramCard,
     device_memory_watermark,
+    device_memory_watermarks,
     publish_program_gauges,
 )
 from speakingstyle_tpu.obs.events import JsonlEventLog, read_events
@@ -57,6 +58,7 @@ __all__ = [
     "Span",
     "build_info",
     "device_memory_watermark",
+    "device_memory_watermarks",
     "enable_compilation_cache",
     "get_registry",
     "process_rss_bytes",

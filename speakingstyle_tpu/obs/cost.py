@@ -217,6 +217,39 @@ def publish_program_gauges(
         ).set(card.peak_bytes)
 
 
+def device_memory_watermarks(
+    card: Optional[ProgramCard] = None, devices=None
+) -> Dict[str, float]:
+    """Per-device memory watermarks: ``{"tpu:0": bytes, ...}`` keyed by
+    ``platform:id`` labels — the multichip spelling of
+    ``device_memory_watermark`` (gauge labels per mesh device). Falls back
+    to the card's argument+temp live set, identical on every device under
+    SPMD. Never raises; backends without stats yield an empty dict."""
+    try:
+        import jax
+
+        devices = list(devices) if devices is not None else jax.local_devices()
+    except Exception:  # jaxlint: disable=JL007
+        return {}
+    out: Dict[str, float] = {}
+    for d in devices:
+        label = f"{getattr(d, 'platform', 'dev')}:{getattr(d, 'id', '?')}"
+        try:
+            stats = d.memory_stats()
+        except Exception:  # jaxlint: disable=JL007
+            stats = None
+        v = None
+        if stats:
+            v = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
+        if not (isinstance(v, (int, float)) and v > 0) and card is not None:
+            parts = [card.argument_bytes, card.temp_bytes]
+            if any(p is not None for p in parts):
+                v = sum(p for p in parts if p is not None)
+        if isinstance(v, (int, float)) and v > 0:
+            out[label] = float(v)
+    return out
+
+
 def device_memory_watermark(card: Optional[ProgramCard] = None):
     """Best-effort device-memory watermark in bytes: the backend's own
     ``memory_stats()`` peak where available (TPU/GPU), else the card's

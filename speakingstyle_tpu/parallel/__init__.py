@@ -1,20 +1,24 @@
 """Parallelism: device meshes, shardings, and sequence-parallel attention."""
 
 from speakingstyle_tpu.parallel.mesh import (
+    BatchShardingError,
     batch_sharding,
     local_batch_size,
     make_mesh,
     make_seq_mesh,
     replicated,
+    resolve_mesh,
     shard_batch,
 )
 from speakingstyle_tpu.parallel.ring_attention import ring_attention, ring_self_attention
 
 __all__ = [
+    "BatchShardingError",
     "make_mesh",
     "make_seq_mesh",
     "batch_sharding",
     "replicated",
+    "resolve_mesh",
     "shard_batch",
     "local_batch_size",
     "ring_attention",

@@ -60,8 +60,65 @@ def shard_batch(batch, mesh: Mesh):
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)
 
 
+class BatchShardingError(ValueError):
+    """Global batch size incompatible with the mesh's ``data`` axis.
+
+    Raised at startup (config/mesh resolution time), before any compile or
+    device transfer, so a bad ``train.optimizer.batch_size`` /
+    ``train.parallel.mesh`` pairing fails with the fix in the message
+    instead of an opaque GSPMD shape error mid-run.
+    """
+
+
+def _mesh_shape_str(mesh: Mesh) -> str:
+    return "x".join(str(mesh.shape[a]) for a in mesh.axis_names)
+
+
 def local_batch_size(global_batch: int, mesh: Mesh) -> int:
+    """Per-shard batch rows for a ``data``-sharded global batch.
+
+    A global batch not divisible by ``dp`` has no defined sharding; the
+    structured error names the batch, the mesh shape, and the two nearest
+    valid batch sizes.
+    """
     n_data = mesh.shape["data"]
     if global_batch % n_data:
-        raise ValueError(f"global batch {global_batch} not divisible by data={n_data}")
+        lo = (global_batch // n_data) * n_data
+        hi = lo + n_data
+        nearest = f"{lo} or {hi}" if lo > 0 else str(hi)
+        raise BatchShardingError(
+            f"global batch {global_batch} is not divisible by the mesh's "
+            f"data axis dp={n_data} (mesh {_mesh_shape_str(mesh)} over axes "
+            f"{tuple(mesh.axis_names)}); nearest valid batch sizes: {nearest}"
+        )
     return global_batch // n_data
+
+
+def resolve_mesh(parallel, devices: Optional[Sequence] = None) -> Optional[Mesh]:
+    """``train.parallel.*`` -> ``Mesh`` (or ``None`` for the single-chip path).
+
+    ``mesh=[1,1]`` with ``seq=1`` returns ``None`` — the trainer then runs
+    its unchanged single-chip path. ``dp=-1`` consumes every device not
+    claimed by ``tp``. Asking for more devices than exist raises with the
+    counts named (on the CPU proxy, set
+    ``XLA_FLAGS=--xla_force_host_platform_device_count=N``).
+    """
+    if parallel.is_single():
+        return None
+    devices = list(devices if devices is not None else jax.devices())
+    dp, tp = parallel.mesh
+    if dp == -1:
+        if len(devices) % tp:
+            raise ValueError(
+                f"train.parallel.mesh [-1, {tp}]: {len(devices)} devices "
+                f"not divisible by tp={tp}"
+            )
+        dp = len(devices) // tp
+    n = dp * tp
+    if n > len(devices):
+        raise ValueError(
+            f"train.parallel.mesh {dp}x{tp} needs {n} devices but only "
+            f"{len(devices)} are visible (CPU proxy: set "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={n})"
+        )
+    return make_mesh(data=dp, model=tp, devices=devices[:n])

@@ -46,6 +46,27 @@ DEFAULT_TP_RULES: List[Tuple[str, P]] = [
 ]
 
 
+def parse_rule_overrides(overrides) -> List[Tuple[str, P]]:
+    """``train.parallel.partition_rules`` -> rule list for ``tp_shardings``.
+
+    Each override is ``[path_regex, axes]`` with ``axes`` a comma-separated
+    per-dim list of mesh axis names or ``none`` (config.py validates the
+    grammar at load time). Overrides are PREPENDED to ``DEFAULT_TP_RULES``
+    so they win first-match; an empty/None input returns the defaults
+    unchanged.
+    """
+    if not overrides:
+        return DEFAULT_TP_RULES
+    rules: List[Tuple[str, P]] = []
+    for pattern, axes in overrides:
+        spec = tuple(
+            None if tok.strip().lower() in ("", "none") else tok.strip()
+            for tok in str(axes).split(",")
+        )
+        rules.append((pattern, P(*spec)))
+    return rules + DEFAULT_TP_RULES
+
+
 def _spec_for(path: str, rules) -> P:
     for pattern, spec in rules:
         if re.match(pattern, path):

@@ -17,6 +17,16 @@ Resilience extensions (ISSUE 2, config: ``train.resilience.*``):
   * **robust latest-step restore** — ``restore(step=None)`` walks steps
     newest-first and falls back past a partial/corrupt checkpoint
     directory (crashed mid-write) instead of bricking the resume.
+
+Sharding awareness / cross-mesh-shape resume (ISSUE 10): the on-disk
+format is mesh-agnostic — ``save()``'s device->host snapshot
+(``jax.device_get``) assembles full global arrays whatever DP/TP layout
+the live state carried — and ``restore()`` builds its abstract template
+from the *passed* state, preserving any shardings its leaves carry. Pass
+a state already laid out for the TARGET mesh (or
+``TrainState.sharded_abstract``) and Orbax materializes each leaf
+directly into that layout: save on an 8x1 DP mesh, restore onto 4x2
+DP×TP or 1x1 single-chip, bit-identically (tests/test_multichip.py).
 """
 
 import os
@@ -170,8 +180,11 @@ class CheckpointManager:
         step: Optional[int] = None,
         ignore_layers: Sequence[str] = (),
     ) -> TrainState:
-        """Restore into the shape of ``state`` (concrete arrays or a
-        jax.ShapeDtypeStruct template, e.g. ``TrainState.abstract()``).
+        """Restore into the shape — and SHARDINGS — of ``state`` (concrete
+        arrays or a jax.ShapeDtypeStruct template, e.g.
+        ``TrainState.abstract()`` / ``TrainState.sharded_abstract()``).
+        Cross-mesh resume rides this: the template names the target mesh's
+        layout and Orbax materializes straight into it.
 
         ``step=None`` restores the latest step, falling back past
         partial/corrupt checkpoint directories (newest-first) so one

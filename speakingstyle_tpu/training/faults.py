@@ -22,18 +22,37 @@ from speakingstyle_tpu.faults import (  # noqa: F401  (re-export)
     TRAINING_KINDS,
     FaultPlan,
     _Fault,
+    dp_poison_rows,
 )
 
 
-def poison_batch(arrays: dict) -> dict:
+def poison_batch(arrays: dict, mesh=None) -> dict:
     """NaN-poison a training batch (the ``nan_grads`` fault): multiplying
     the mel targets by NaN drives every loss and every gradient non-finite
     through the real loss/grad path, exactly like a diverged model or a
-    corrupt feature file would."""
+    corrupt feature file would.
+
+    Under a DP mesh the poison is SHARD-LOCAL (``dp_poison_rows`` — the
+    first data shard's rows only): the adversarial drill that proves the
+    sentinel's dp-axis reduction, since only an all-reduced ``_finite``
+    flag makes every device roll back on one shard's NaN."""
+    import jax
     import jax.numpy as jnp
 
     out = dict(arrays)
-    out["mels"] = out["mels"] * jnp.float32(jnp.nan)
+    mels = out["mels"]
+    dp = mesh.shape.get("data", 1) if mesh is not None else 1
+    rows = dp_poison_rows(mels.shape[0], dp)
+    if rows < mels.shape[0]:
+        poisoned = jnp.asarray(mels).at[:rows].multiply(jnp.float32(jnp.nan))
+    else:
+        poisoned = mels * jnp.float32(jnp.nan)
+    # eager .at updates may drop the batch sharding; pin it back so the
+    # poisoned batch enters the jitted step with the layout it came with
+    sharding = getattr(mels, "sharding", None)
+    if mesh is not None and sharding is not None:
+        poisoned = jax.device_put(poisoned, sharding)
+    out["mels"] = poisoned
     return out
 
 

@@ -171,7 +171,15 @@ def all_finite(*trees):
     """Scalar bool array: every inexact-dtype leaf of every tree is
     finite. Traced into the jitted step, this is a handful of fused
     on-device reductions — the host only reads the single resulting
-    scalar at the log boundary, where it already blocks for logging."""
+    scalar at the log boundary, where it already blocks for logging.
+
+    Under a DP mesh the reductions run over ``data``-sharded grads, so
+    GSPMD lowers them to cross-device all-reduces; the trainer
+    additionally pins the flag fully replicated
+    (``with_sharding_constraint``) so the dp-axis reduction is an explicit
+    part of the compiled step — one shard's NaN flips the flag on EVERY
+    device, and every host reads the same rollback verdict (drilled by
+    the shard-local ``nan_grads`` fault, tests/test_multichip.py)."""
     import jax
     import jax.numpy as jnp
 

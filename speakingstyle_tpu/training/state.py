@@ -46,3 +46,20 @@ class TrainState:
             )
 
         return jax.tree_util.tree_map(to_sds, self)
+
+    def sharded_abstract(self, shardings) -> "TrainState":
+        """Abstract template carrying EXPLICIT target shardings — the
+        cross-mesh-shape resume spelling.
+
+        ``shardings`` is a matching TrainState pytree of shardings (e.g.
+        ``parallel.partition.train_state_shardings`` over the TARGET mesh,
+        or a replicated tree for pure DP). Restoring a checkpoint against
+        this template materializes it directly into the target layout,
+        regardless of the mesh shape that wrote it — no host round-trip
+        through the source layout."""
+
+        def to_sds(x, s):
+            return jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x),
+                                        sharding=s)
+
+        return jax.tree_util.tree_map(to_sds, self, shardings)

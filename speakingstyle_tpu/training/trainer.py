@@ -125,7 +125,17 @@ def make_train_step(model, tx, cfg: Config, mesh=None, state_shardings=None):
         )(state.params)
         if nan_sentinel:  # trace-time flag: compiled in or out, never branched
             losses = dict(losses)
-            losses["_finite"] = resilience.all_finite(losses, grads)
+            flag = resilience.all_finite(losses, grads)
+            if mesh is not None:
+                # explicit dp-axis reduction: pin the flag fully replicated
+                # so GSPMD compiles the all-reduce over the data axis into
+                # the step itself — every device holds the same verdict and
+                # every host reads the same rollback decision (one shard's
+                # NaN trips all of them; drilled by the nan_grads DP fault)
+                flag = jax.lax.with_sharding_constraint(
+                    flag, NamedSharding(mesh, P())
+                )
+            losses["_finite"] = flag
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
         new_state = state.replace(
@@ -229,9 +239,15 @@ def evaluate(eval_step, state, batches: Iterator) -> Dict[str, float]:
     return {k: v / count for k, v in sums.items()}
 
 
+# run_training's mesh default: "resolve from cfg.train.parallel". An
+# explicit mesh=None pins the single-chip path even when the config block
+# names a mesh (the CLI's flag-override contract).
+_MESH_FROM_CONFIG = object()
+
+
 def run_training(
     cfg: Config,
-    mesh=None,
+    mesh=_MESH_FROM_CONFIG,
     restore_step: Optional[int] = None,
     max_steps: Optional[int] = None,
     synth_callback=None,
@@ -288,10 +304,23 @@ def run_training(
     from speakingstyle_tpu.training.checkpoint import CheckpointManager
     from speakingstyle_tpu.training.optim import make_lr_schedule, make_optimizer
 
+    from speakingstyle_tpu.parallel.mesh import local_batch_size, resolve_mesh
+
     steps = cfg.train.step
     res = cfg.train.resilience
     total_step = max_steps if max_steps is not None else steps.total_step
     plan = faults.FaultPlan.from_env()
+
+    # train.parallel.* is the multichip contract: mesh=[1,1] resolves to
+    # None and this function behaves exactly as the single-chip path; an
+    # explicitly passed mesh — including an explicit None — wins (tests,
+    # cli flag overrides)
+    if mesh is _MESH_FROM_CONFIG:
+        mesh = resolve_mesh(cfg.train.parallel)
+    if mesh is not None:
+        # startup divisibility gate: fails with the two nearest valid
+        # batch sizes named, before any compile or transfer
+        local_batch_size(cfg.train.optimizer.batch_size, mesh)
 
     registry = registry if registry is not None else obs.get_registry()
     step_hist = registry.histogram(
@@ -344,25 +373,36 @@ def run_training(
         async_save=res.async_checkpointing,
         keep_best=res.keep_best,
     )
+
+    state_shardings = None
+    tp_rules = None
+    if mesh is not None:
+        from speakingstyle_tpu.parallel.partition import (
+            parse_rule_overrides,
+            shard_train_state,
+            train_state_shardings,
+        )
+
+        if cfg.train.parallel.partition_rules:
+            tp_rules = parse_rule_overrides(cfg.train.parallel.partition_rules)
+        if mesh.shape.get("model", 1) > 1:
+            state_shardings = train_state_shardings(state, mesh, tp_rules)
+            state = shard_train_state(state, mesh, tp_rules)
+        else:
+            state = jax.device_put(state, NamedSharding(mesh, P()))
+
     if restore_step is not None:
+        # cross-mesh-shape resume: the restore runs AFTER sharding, so the
+        # state passed in already carries THIS run's (target) mesh layout.
+        # CheckpointManager.restore builds its abstract template from those
+        # shardings and Orbax materializes the checkpoint directly into the
+        # target layout — whatever mesh shape wrote it (save on 8x1,
+        # restore onto 4x2 or 1x1).
         state = ckpt.restore(
             state,
             step=restore_step if restore_step > 0 else None,
             ignore_layers=cfg.train.ignore_layers,
         )
-
-    state_shardings = None
-    if mesh is not None:
-        if mesh.shape.get("model", 1) > 1:
-            from speakingstyle_tpu.parallel.partition import (
-                shard_train_state,
-                train_state_shardings,
-            )
-
-            state_shardings = train_state_shardings(state, mesh)
-            state = shard_train_state(state, mesh)
-        else:
-            state = jax.device_put(state, NamedSharding(mesh, P()))
 
     train_step = make_train_step(
         model, tx, cfg, mesh=mesh, state_shardings=state_shardings
@@ -411,7 +451,7 @@ def run_training(
             if state_shardings is not None:
                 from speakingstyle_tpu.parallel.partition import shard_train_state
 
-                s = shard_train_state(s, mesh)
+                s = shard_train_state(s, mesh, tp_rules)
             else:
                 s = jax.device_put(s, NamedSharding(mesh, P()))
         return s
@@ -439,11 +479,22 @@ def run_training(
         logger = TrainLogger(
             cfg.train.path.log_path, registry=registry, events=events
         )
+    # per-chip observability: gauge labels name each mesh device; on the
+    # single-chip path the one label is the default device
+    mesh_devices = (
+        list(mesh.devices.flat) if mesh is not None else jax.devices()[:1]
+    )
+    n_mesh_devices = len(mesh_devices)
+    device_labels = [f"{d.platform}:{d.id}" for d in mesh_devices]
     if logger:
-        # one identity record per run: build + runtime stack, so a log
-        # directory is attributable without the shell that launched it
+        # one identity record per run: build + runtime stack + mesh shape,
+        # so a log directory is attributable without the shell that
+        # launched it
         logger.event(
             "train_start", step=step, total_step=total_step,
+            mesh_shape=(dict(mesh.shape) if mesh is not None
+                        else {"data": 1, "model": 1}),
+            mesh_devices=n_mesh_devices,
             **obs.build_info(),
         )
     if synth_callback == "default":
@@ -478,7 +529,10 @@ def run_training(
                 wait_hist.observe(data_wait)
                 window_wait += data_wait
                 if plan.fire("nan_grads", step + 1):
-                    arrays = faults.poison_batch(arrays)
+                    # under a DP mesh the poison is shard-local (one
+                    # device's rows only): the harsher drill — the
+                    # sentinel's dp-axis reduction must trip everywhere
+                    arrays = faults.poison_batch(arrays, mesh=mesh)
                     fault_ctr.inc()
                     if logger:
                         logger.event("fault_fire", kind="nan_grads",
@@ -509,6 +563,17 @@ def run_training(
                 if program_card is not None and program_card.flops \
                         and step_time > 0:
                     flops_hist.observe(program_card.flops / step_time)
+                    # per-device MFU gauges: SPMD splits the step's FLOPs
+                    # evenly over the mesh, so each chip's achieved rate is
+                    # the program total divided by the device count
+                    per_dev = program_card.flops / n_mesh_devices / step_time
+                    for dev in device_labels:
+                        registry.gauge(
+                            "train_achieved_flops_per_sec",
+                            labels={"device": dev},
+                            help="per-device achieved FLOP/s share of the "
+                                 "train step program",
+                        ).set(per_dev)
                 window_frames += int(batch.mel_lens.sum())  # host-side, no sync
                 if trace_active and step - start_step >= profile_steps[1]:
                     jax.block_until_ready(losses["total_loss"])
@@ -562,6 +627,16 @@ def run_training(
                     watermark = obs.device_memory_watermark(program_card)
                     if watermark is not None:
                         mem_gauge.set(watermark)
+                    for dev, wm in obs.device_memory_watermarks(
+                        program_card, devices=mesh_devices
+                    ).items():
+                        registry.gauge(
+                            "device_memory_watermark_bytes",
+                            labels={"device": dev},
+                            help="per-device memory watermark (backend "
+                                 "memory_stats peak, else ProgramCard "
+                                 "argument+temp bytes)",
+                        ).set(wm)
                     if logger:
                         contracts.assert_tree_finite(
                             public_losses(losses), "train_step.losses"

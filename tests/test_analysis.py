@@ -777,6 +777,61 @@ def test_jl013_negative_outside_serving():
 
 
 # ---------------------------------------------------------------------------
+# JL014 — hard single-device pinning in training/data code
+# ---------------------------------------------------------------------------
+
+
+def test_jl014_positive_direct_and_via_name():
+    src = """
+        import jax
+
+        def load(batch):
+            dev = jax.local_devices()[0]
+            a = jax.device_put(batch, jax.devices()[0])
+            b = jax.device_put(batch, device=dev)
+            return a, b
+    """
+    details = sorted({
+        f.detail for f in linter.lint_source(
+            textwrap.dedent(src), "speakingstyle_tpu/training/fake.py"
+        ) if f.rule == "JL014"
+    })
+    assert details == [
+        "device_put pinned to dev",
+        "device_put pinned to jax.devices()[...]",
+    ]
+
+
+def test_jl014_positive_under_data_path():
+    assert "JL014" in _codes("""
+        import jax
+
+        def put(v):
+            return jax.device_put(v, jax.devices()[0])
+    """, path="speakingstyle_tpu/data/fake.py")
+
+
+def test_jl014_negative_sharding_device_put():
+    # the contract: device_put against a NamedSharding (or no device)
+    assert "JL014" not in _codes("""
+        import jax
+
+        def put(v, sharding):
+            return {"a": jax.device_put(v, sharding), "b": jax.device_put(v)}
+    """, path="speakingstyle_tpu/data/fake.py")
+
+
+def test_jl014_negative_outside_training_and_data():
+    # scoped: ops/ kernels and obs/ probes legitimately address one device
+    assert "JL014" not in _codes("""
+        import jax
+
+        def probe(v):
+            return jax.device_put(v, jax.devices()[0])
+    """, path="speakingstyle_tpu/ops/fake.py")
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -896,6 +951,9 @@ def test_every_rule_is_non_vacuous():
     # fixtures above keep them non-vacuous. JL013 fires on the real tree
     # via its one baselined hit (the batcher's condition-protected
     # collect wait), so it is covered by the baseline union below.
+    # JL014 is likewise deliberately absent: training/ and data/ already
+    # device_put against NamedShardings only (the hard pins that remain
+    # live in ops/ and obs/, outside the rule's scope on purpose).
     for code in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
                  "JL007", "JL008"):
         assert code in fired, f"{code} never fires on the real tree"
@@ -931,6 +989,8 @@ def test_cli_check_exits_zero_on_repo():
     ("JL012", "class F:\n    def __init__(self):\n"
               "        self._mel_cache = {}\n"),
     ("JL013", "def serve(future):\n    return future.result()\n"),
+    ("JL014", "import jax\n\ndef put(v):\n"
+              "    return jax.device_put(v, jax.devices()[0])\n"),
 ])
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
     # JL004 is scoped to training/ paths; JL007 to speakingstyle_tpu/;
