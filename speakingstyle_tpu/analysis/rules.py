@@ -51,6 +51,12 @@ JL014  hard single-device pinning in training/data code:
        the trainer runs on a mesh, placement is a sharding contract;
        a pin to device 0 funnels every batch onto one chip of the mesh
        (correct but 1/N throughput). Pass a NamedSharding instead.
+JL015  fresh ndarray allocation in the serving hot path: np.zeros/
+       np.full/np.pad/np.concatenate in a dispatch loop or request
+       handler under speakingstyle_tpu/serving/ — steady-state serving
+       is allocation-free by contract (per-bucket BufferPool leases,
+       serving/pool.py); a per-request allocation puts malloc and
+       page-zeroing jitter straight into the p999
 """
 
 import ast
@@ -1689,6 +1695,77 @@ def rule_jl014(mod: ModuleInfo) -> Iterator[Finding]:
             break
 
 
+# ---------------------------------------------------------------------------
+# JL015 — fresh ndarray allocation in the serving hot path
+# ---------------------------------------------------------------------------
+
+
+_FRESH_ALLOC_CALLS = {
+    "np.zeros", "np.full", "np.pad", "np.concatenate",
+    "numpy.zeros", "numpy.full", "numpy.pad", "numpy.concatenate",
+}
+
+
+def _is_dispatch_shaped(name: str) -> bool:
+    """Hot-path heuristics for serving code: request handlers (JL008's
+    definition) plus dispatch/emit-loop workers (``_dispatch``,
+    ``dispatch_loop``, ``stream_wav``-style emitters)."""
+    low = name.lower()
+    return _is_handler_name(name) or "dispatch" in low or "emit" in low
+
+
+def rule_jl015(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL015: fresh ndarray allocation in the serving hot path —
+    ``np.zeros``/``np.full``/``np.pad``/``np.concatenate`` inside a loop,
+    or anywhere in a dispatch-/handler-shaped function, under
+    ``speakingstyle_tpu/serving/``.
+
+    The steady-state serving claim is *allocation-free*: every padded
+    staging buffer is leased from the per-bucket BufferPool
+    (serving/pool.py) and written in place, so the dispatch loop's
+    allocator traffic is zero after warmup (``serve_pool_allocs_total``
+    flat).  A fresh ``np.zeros``/``np.pad`` per request reintroduces
+    malloc/free (and page-zeroing) jitter exactly where the p999 is
+    made, and ``np.concatenate`` re-materializes whole utterances the
+    streaming path deliberately emits window-by-window.  Lease from the
+    pool and ``np.copyto``/slice-assign instead.  Functions named
+    ``precompile``/``warmup`` are exempt — startup may allocate freely.
+    """
+    p = mod.path.replace("\\", "/")
+    if "speakingstyle_tpu/serving/" not in p:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func)
+        if callee not in _FRESH_ALLOC_CALLS:
+            continue
+        qual = mod.qualname(node)
+        if any(m in qual.lower() for m in _COMPILE_EXEMPT_MARKERS):
+            continue
+        fn = mod.enclosing_function(node)
+        in_loop = bool(mod.enclosing_loops(node))
+        in_dispatch = fn is not None and _is_dispatch_shaped(fn.name)
+        if not in_loop and not in_dispatch:
+            continue
+        where = "loop" if in_loop else "dispatch/handler function"
+        yield Finding(
+            rule="JL015",
+            path=mod.path,
+            line=node.lineno,
+            context=qual,
+            detail=f"{callee} in {where}",
+            message=(
+                f"`{callee}` inside a {where} ({qual}): a fresh ndarray "
+                "per request breaks the allocation-free steady state — "
+                "malloc + page-zero jitter lands straight in the latency "
+                "tail. Lease a padded buffer from the BufferPool "
+                "(serving/pool.py) and write in place; "
+                "precompile/warmup-named functions are exempt."
+            ),
+        )
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -1704,4 +1781,5 @@ RULES = {
     "JL012": rule_jl012,
     "JL013": rule_jl013,
     "JL014": rule_jl014,
+    "JL015": rule_jl015,
 }

@@ -264,6 +264,11 @@ def main(args):
     signal.signal(signal.SIGTERM, _sigterm)
 
     host, port = server.address[:2]
+    print(
+        f"latency pipeline: frontend_workers={cfg.serve.frontend_workers} "
+        f"(0 = inline G2P), stream_depth={cfg.serve.fleet.stream_depth} "
+        "(1 = sequential vocode)", flush=True,
+    )
     print(f"serving on http://{host}:{port} "
           "(POST /synthesize, POST /synthesize/stream, POST /styles, "
           "GET /styles, GET /healthz, GET /metrics, GET /debug/programs, "

@@ -567,6 +567,12 @@ class FleetConfig:
     # field (streaming.receptive_field_frames), which is the smallest
     # overlap that keeps chunk seams bit-exact
     stream_overlap: int = 0
+    # vocoder windows in flight per stream: window k+1 is dispatched
+    # before window k is collected (JAX async dispatch), so steady-state
+    # chunk cadence is max(device window, host trim+emit) instead of
+    # their sum; 1 = strictly sequential (the pre-pipeline behavior,
+    # bit-identical output)
+    stream_depth: int = 2
     # SIGTERM/shutdown waits this long for in-flight streams to finish
     drain_timeout_s: float = 10.0
     # --- resilience (serving/resilience.py, ARCHITECTURE.md "Serving
@@ -622,6 +628,10 @@ class FleetConfig:
         if self.stream_overlap < 0:
             raise ValueError(
                 f"fleet.stream_overlap must be >= 0, got {self.stream_overlap}"
+            )
+        if self.stream_depth < 1:
+            raise ValueError(
+                f"fleet.stream_depth must be >= 1, got {self.stream_depth}"
             )
         if self.drain_timeout_s < 0:
             raise ValueError(
@@ -745,6 +755,13 @@ class ServeConfig:
     # emit serve_dispatch / http_request JSONL events (obs/events.py
     # schema) under train.path.log_path — req_id joins the two streams
     log_events: bool = False
+    # host frontend worker pool: text normalization/G2P/phoneme encoding
+    # runs off the dispatch path on this many threads, so frontend work
+    # for request k+1 overlaps device dispatch of request k (requests
+    # enter the queue with a resolved-or-pending frontend handle);
+    # 0 = inline frontend on the HTTP handler thread (the pre-pipeline
+    # behavior)
+    frontend_workers: int = 2
     # fleet serving: multi-replica router, SLO admission, streaming
     fleet: FleetConfig = field(default_factory=FleetConfig)
     # style service: AOT reference-encoder lattice + embedding cache
@@ -768,6 +785,11 @@ class ServeConfig:
         if self.frames_per_phoneme <= 0:
             raise ValueError(
                 f"serve.frames_per_phoneme must be > 0, got {self.frames_per_phoneme}"
+            )
+        if self.frontend_workers < 0:
+            raise ValueError(
+                f"serve.frontend_workers must be >= 0 (0 = inline), "
+                f"got {self.frontend_workers}"
             )
 
 

@@ -11,7 +11,7 @@ kinds, all plain Python + one lock each:
   * ``Gauge`` — a settable level (queue depth, last loss).
   * ``Histogram`` — bounded buckets (a fixed edge list chosen at
     creation) with cumulative counts, sum, min/max, and percentile
-    *estimates* (p50/p95/p99 by linear interpolation inside the covering
+    *estimates* (p50/p95/p99/p999 by linear interpolation inside the covering
     bucket — error bounded by one bucket width, tested against a numpy
     reference in tests/test_obs.py).
 
@@ -215,6 +215,7 @@ class Histogram:
             "p50": self.percentile(0.50),
             "p95": self.percentile(0.95),
             "p99": self.percentile(0.99),
+            "p999": self.percentile(0.999),
             "buckets": buckets,
         }
 
@@ -319,6 +320,20 @@ class MetricsRegistry:
                 lines.append(
                     f"{name}_count{_render_labels(labels)} {snap['count']}"
                 )
+                # tail summary lines: the SLO router's operating metric is
+                # the tail, and the bucketed p999 a scraper would derive is
+                # strictly worse than the min/max-tightened estimate the
+                # registry already has — export it (and the true max)
+                # directly, skipping empty histograms
+                if snap["count"]:
+                    lines.append(
+                        f"{name}_p999{_render_labels(labels)} "
+                        f"{snap['p999']:g}"
+                    )
+                    lines.append(
+                        f"{name}_max{_render_labels(labels)} "
+                        f"{snap['max']:g}"
+                    )
         return "\n".join(lines) + "\n"
 
 

@@ -129,6 +129,11 @@ class ContinuousBatcher:
             "serve_request_latency_seconds",
             help="request arrival -> result latency through the batcher",
         )
+        self._queue_wait_hist = self.registry.histogram(
+            "serve_queue_wait_seconds",
+            help="submit -> dispatch-start wait (the coalescing window "
+                 "the frontend pool overlaps with)",
+        )
         self.thread = threading.Thread(
             target=self._worker, name="serve-dispatch", daemon=True
         )
@@ -201,7 +206,11 @@ class ContinuousBatcher:
             self._rejected_ctr.inc()
             raise ShutdownError("batcher is closed")
         self._check_shed()          # raises Overloaded under backpressure
-        self.engine.admit(request)  # raises RequestTooLarge early
+        if not getattr(request, "pending", False):
+            self.engine.admit(request)  # raises RequestTooLarge early
+        # pending frontend handles (serving/frontend.py) have no sequence
+        # yet — geometry moves to _resolve_pending at dispatch, where a
+        # RequestTooLarge resolves the future with the same 400 verdict
         fut: Future = Future()
         item = _Pending(
             request=request,
@@ -240,9 +249,30 @@ class ContinuousBatcher:
             batch.append(item)
         return batch, False
 
+    def _resolve_pending(self, p: _Pending) -> bool:
+        """Swap a frontend handle for its resolved SynthesisRequest in
+        place. False = resolution failed; the future already carries the
+        frontend's error (or TimeoutError for a wedged worker) and the
+        entry must leave the batch."""
+        if not getattr(p.request, "pending", False):
+            return True
+        try:
+            request = p.request.resolve()
+            self.engine.admit(request)  # geometry deferred from submit
+        except BaseException as e:
+            p.future.set_exception(e)
+            return False
+        p.request = request
+        return True
+
     def _dispatch(self, batch: List[_Pending]) -> None:
+        batch[:] = [p for p in batch if self._resolve_pending(p)]
+        if not batch:
+            return
         req_ids = [p.request.id for p in batch]
         t0 = time.monotonic()
+        for p in batch:
+            self._queue_wait_hist.observe(t0 - p.request.arrival)
         try:
             results = self.engine.run([p.request for p in batch])
         except BaseException as e:

@@ -64,6 +64,7 @@ from speakingstyle_tpu.obs.cost import (
     publish_program_gauges,
 )
 from speakingstyle_tpu.serving.lattice import Bucket, BucketLattice, RequestTooLarge
+from speakingstyle_tpu.serving.pool import BufferPool
 from speakingstyle_tpu.serving.resilience import InjectedFault
 from speakingstyle_tpu.serving.style import StyleService, StyleVectors
 from speakingstyle_tpu.training.resilience import retry_io
@@ -73,6 +74,7 @@ __all__ = [
     "SynthesisEngine",
     "SynthesisRequest",
     "SynthesisResult",
+    "VocodeHandle",
     "bucket_label",
 ]
 
@@ -151,10 +153,10 @@ def _quiet_donation():
         yield
 
 
-def _fill_control(rows: List[Control], B: int, L: int) -> np.ndarray:
-    """Per-request controls -> one padded [B, L] float32 array (padding
-    rows/positions get the neutral 1.0; they are masked downstream)."""
-    out = np.ones((B, L), np.float32)
+def _fill_control(rows: List[Control], out: np.ndarray) -> np.ndarray:
+    """Per-request controls -> the padded [B, L] float32 array ``out``
+    (pool-leased, pre-filled with the neutral 1.0; padding rows/positions
+    keep it and are masked downstream)."""
     for i, c in enumerate(rows):
         if np.isscalar(c):
             out[i] = float(c)
@@ -162,6 +164,24 @@ def _fill_control(rows: List[Control], B: int, L: int) -> np.ndarray:
             arr = np.asarray(c, np.float32)
             out[i, : arr.shape[0]] = arr
     return out
+
+
+@dataclass
+class VocodeHandle:
+    """One in-flight vocoder window: the async device dispatch plus the
+    pooled host buffer it was padded from.
+
+    ``vocode_dispatch`` returns at enqueue (JAX async dispatch);
+    ``vocode_collect`` is the only sync point and the only place the
+    pooled buffer is returned. A handle that will never be collected
+    (an abandoned stream, a faulted pipeline) MUST go through
+    ``vocode_abandon`` so the buffer still comes back — the streaming
+    layer does this in a ``finally``."""
+
+    wav_dev: object                # device array, result of the exe call
+    t_w: int                       # real frames in the window
+    hop: int                       # generator hop factor (trim unit)
+    buf: Optional[np.ndarray]      # pooled input buffer; None once released
 
 
 class SynthesisEngine:
@@ -251,6 +271,26 @@ class SynthesisEngine:
             "serve_style_degraded_total",
             help="requests whose style fell back to the default (all-zero "
                  "FiLM) because the reference encoder failed",
+        )
+        # host staging buffers: every dispatch leases its padded inputs
+        # from here instead of allocating (ARCHITECTURE.md "Latency
+        # pipeline" — the allocation-free-steady-state claim)
+        self.pool = BufferPool(registry=self.registry)
+        # per-stage latency histograms for the pipelined hot path
+        # (bench.py --latency reads these for its stage breakdown)
+        self._acoustic_hist = self.registry.histogram(
+            "serve_acoustic_seconds",
+            help="stage: acoustic dispatch incl. staging, transfer, and "
+                 "the mel host readback",
+        )
+        self._vocoder_hist = self.registry.histogram(
+            "serve_vocoder_seconds",
+            help="stage: wall time blocked on a vocoder window's device "
+                 "result (residual device time once the pipeline overlaps)",
+        )
+        self._emit_hist = self.registry.histogram(
+            "serve_emit_seconds",
+            help="stage: host wav conversion + overlap trim per window",
         )
 
     @property
@@ -409,20 +449,27 @@ class SynthesisEngine:
 
     # -- streaming window vocode --------------------------------------------
 
-    def vocode_window(self, mel: np.ndarray) -> np.ndarray:
-        """Vocode one mel window ``[T_w, n_mels]`` -> int16 wav
-        ``[T_w * hop]`` through the precompiled lattice.
+    def vocode_dispatch(self, mel: np.ndarray) -> VocodeHandle:
+        """Enqueue one mel window ``[T_w, n_mels]`` on the precompiled
+        vocoder lattice and return without blocking.
 
         The window is padded into the smallest ``(batch, T_mel)`` vocoder
-        bucket that covers it, so streaming chunks ride the same AOT
-        programs as full-utterance dispatches — a steady-state stream
-        performs ZERO compiles. A miss (window larger than every mel
-        bucket) raises RequestTooLarge via ``cover``; an uncompiled
+        bucket that covers it — into a pool-leased buffer, not a fresh
+        allocation — so streaming chunks ride the same AOT programs as
+        full-utterance dispatches: a steady-state stream performs ZERO
+        compiles and ZERO allocations. A miss (window larger than every
+        mel bucket) raises RequestTooLarge via ``cover``; an uncompiled
         covering bucket compiles once under the engine lock and is
         counted, exactly like ``run``'s miss path.
+
+        The returned handle rides JAX async dispatch: the executable call
+        returns at enqueue, so the caller can dispatch window k+1 before
+        collecting window k (serving/streaming.py does exactly that).
+        Every handle must reach ``vocode_collect`` or ``vocode_abandon``
+        — that is where the pooled buffer comes back.
         """
         if self.vocoder is None:
-            raise ValueError("vocode_window requires a vocoder engine")
+            raise ValueError("vocode_dispatch requires a vocoder engine")
         if mel.ndim != 2 or mel.shape[1] != self.n_mels:
             raise ValueError(
                 f"mel window must be [T, {self.n_mels}], got {mel.shape}"
@@ -444,18 +491,61 @@ class SynthesisEngine:
             if key not in self._vocoder_exe:
                 self._compile_vocoder(*key)
         gen, params = self.vocoder
-        padded = np.zeros((key[0], key[1], self.n_mels), np.float32)
-        padded[0, :t_w] = mel
-        wav_dev = self._vocoder_exe[key](params, self._transfer(
-            {"mel": padded})["mel"])
-        # host-side row select: slicing the device array would trace a
-        # gather op — one stray backend compile per shape, which the
-        # zero-steady-state-compiles monitor rightly flags
-        wav = np.clip(
-            np.asarray(wav_dev)[0] * self.max_wav_value,
-            -self.max_wav_value, self.max_wav_value - 1,
-        ).astype(np.int16)
-        return wav[: t_w * gen.hop_factor]
+        padded = self.pool.acquire((key[0], key[1], self.n_mels), np.float32)
+        try:
+            padded[0, :t_w] = mel
+            wav_dev = self._vocoder_exe[key](params, self._transfer(
+                {"mel": padded})["mel"])
+        except BaseException:
+            self.pool.release(padded)
+            raise
+        return VocodeHandle(
+            wav_dev=wav_dev, t_w=t_w, hop=gen.hop_factor, buf=padded
+        )
+
+    def _release_handle(self, handle: VocodeHandle) -> None:
+        if handle.buf is not None:
+            self.pool.release(handle.buf)
+            handle.buf = None
+
+    def vocode_collect(self, handle: VocodeHandle) -> np.ndarray:
+        """Block on a dispatched window and convert it: int16 wav
+        ``[t_w * hop]``. The handle's pooled buffer is released here —
+        after the host sync, the portable point at which the device can
+        no longer be reading it."""
+        try:
+            t0 = time.monotonic()
+            # host-side row select: slicing the device array would trace
+            # a gather op — one stray backend compile per shape, which
+            # the zero-steady-state-compiles monitor rightly flags
+            wav_host = np.asarray(handle.wav_dev)  # <- the sync point
+            t1 = time.monotonic()
+            wav = np.clip(
+                wav_host[0] * self.max_wav_value,
+                -self.max_wav_value, self.max_wav_value - 1,
+            ).astype(np.int16)[: handle.t_w * handle.hop]
+            self._vocoder_hist.observe(t1 - t0)
+            self._emit_hist.observe(time.monotonic() - t1)
+            return wav
+        finally:
+            self._release_handle(handle)
+
+    def vocode_abandon(self, handle: VocodeHandle) -> None:
+        """Return an in-flight window's buffer without converting it —
+        the path for a stream that dies mid-pipeline (client disconnect,
+        injected fault on a later window). Blocks until the device is
+        done with the input, then releases; never raises."""
+        try:
+            handle.wav_dev.block_until_ready()
+        except Exception:  # jaxlint: disable=JL007
+            pass  # a failed dispatch cannot still be reading the buffer
+        self._release_handle(handle)
+
+    def vocode_window(self, mel: np.ndarray) -> np.ndarray:
+        """Vocode one mel window synchronously (dispatch + collect) —
+        the sequential surface ``run``'s non-stream path and the tests'
+        bit-exactness reference use."""
+        return self.vocode_collect(self.vocode_dispatch(mel))
 
     # -- admission geometry -------------------------------------------------
 
@@ -589,63 +679,100 @@ class SynthesisEngine:
         b, l, t = bucket.b, bucket.l_src, bucket.t_mel
         n = len(requests)
 
-        speakers = np.zeros((b,), np.int32)
-        texts = np.zeros((b, l), np.int32)
-        src_lens = np.zeros((b,), np.int32)
-        gammas = np.zeros((b, 1, self._film_dim), np.float32)
-        betas = np.zeros((b, 1, self._film_dim), np.float32)
-        for i, r in enumerate(requests):
-            speakers[i] = r.speaker
-            texts[i, : len(r.sequence)] = r.sequence
-            src_lens[i] = len(r.sequence)
-            if styles[i] is not None:
-                gammas[i, 0] = styles[i].gamma
-                betas[i, 0] = styles[i].beta
-        arrays = {
-            "speakers": speakers,
-            "texts": texts,
-            "src_lens": src_lens,
-            "gammas": gammas,
-            "betas": betas,
-            "p_control": _fill_control(
-                [r.p_control for r in requests], b,
-                self._ctl_len(self._pitch_axis, bucket)),
-            "e_control": _fill_control(
-                [r.e_control for r in requests], b,
-                self._ctl_len(self._energy_axis, bucket)),
-            "d_control": _fill_control(
-                [r.d_control for r in requests], b, l),
-        }
-        dev = self._transfer(arrays)
-        out = self._acoustic[bucket](
-            self.variables, dev["speakers"], dev["texts"], dev["src_lens"],
-            dev["gammas"], dev["betas"], dev["p_control"], dev["e_control"],
-            dev["d_control"],
-        )
-        mel_out = out["mel_postnet"]  # [b, t, n_mels] device array
+        # staging buffers are pool leases, not fresh allocations; the
+        # try/finally returns every lease on success, fault, or a stolen
+        # batch (the worker thread still unwinds through here)
+        leases: List[np.ndarray] = []
+        dev: Dict[str, object] = {}
+        synced = False  # becomes True at the mel host readback
 
-        wavs = None
-        hop = 1
-        # streaming rows are vocoded window-by-window later
-        # (serving/streaming.py); a batch of only-stream requests skips
-        # the full-utterance vocode entirely — that skipped work IS the
-        # time-to-first-audio win
-        if self.vocoder is not None and any(not r.stream for r in requests):
-            gen, params = self.vocoder
-            hop = gen.hop_factor
-            # donation consumes mel_out on device — read the mel back
-            # BEFORE vocoding
-            mel_host = np.asarray(mel_out)
-            wav_dev = self._vocoder_exe[(bucket.b, t)](params, mel_out)
-            # one vectorized int16 conversion for the whole batch (the
-            # per-item numpy work is what bounds coalesced throughput on
-            # the CPU bench)
-            wavs = np.clip(
-                np.asarray(wav_dev) * self.max_wav_value,
-                -self.max_wav_value, self.max_wav_value - 1,
-            ).astype(np.int16)
-        else:
-            mel_host = np.asarray(mel_out)
+        def staging(shape, dtype=np.float32, fill: float = 0) -> np.ndarray:
+            buf = self.pool.acquire(shape, dtype, fill)
+            leases.append(buf)
+            return buf
+
+        try:
+            speakers = staging((b,), np.int32)
+            texts = staging((b, l), np.int32)
+            src_lens = staging((b,), np.int32)
+            gammas = staging((b, 1, self._film_dim))
+            betas = staging((b, 1, self._film_dim))
+            for i, r in enumerate(requests):
+                speakers[i] = r.speaker
+                texts[i, : len(r.sequence)] = r.sequence
+                src_lens[i] = len(r.sequence)
+                if styles[i] is not None:
+                    gammas[i, 0] = styles[i].gamma
+                    betas[i, 0] = styles[i].beta
+            arrays = {
+                "speakers": speakers,
+                "texts": texts,
+                "src_lens": src_lens,
+                "gammas": gammas,
+                "betas": betas,
+                # controls pad with the neutral 1.0, so the lease
+                # pre-fills with it
+                "p_control": _fill_control(
+                    [r.p_control for r in requests], staging(
+                        (b, self._ctl_len(self._pitch_axis, bucket)),
+                        fill=1)),
+                "e_control": _fill_control(
+                    [r.e_control for r in requests], staging(
+                        (b, self._ctl_len(self._energy_axis, bucket)),
+                        fill=1)),
+                "d_control": _fill_control(
+                    [r.d_control for r in requests], staging((b, l),
+                                                             fill=1)),
+            }
+            dev = self._transfer(arrays)
+            out = self._acoustic[bucket](
+                self.variables, dev["speakers"], dev["texts"],
+                dev["src_lens"], dev["gammas"], dev["betas"],
+                dev["p_control"], dev["e_control"], dev["d_control"],
+            )
+            mel_out = out["mel_postnet"]  # [b, t, n_mels] device array
+
+            wavs = None
+            hop = 1
+            # streaming rows are vocoded window-by-window later
+            # (serving/streaming.py); a batch of only-stream requests
+            # skips the full-utterance vocode entirely — that skipped
+            # work IS the time-to-first-audio win
+            if self.vocoder is not None and \
+                    any(not r.stream for r in requests):
+                gen, params = self.vocoder
+                hop = gen.hop_factor
+                # donation consumes mel_out on device — read the mel
+                # back BEFORE vocoding
+                mel_host = np.asarray(mel_out)
+                synced = True
+                self._acoustic_hist.observe(time.monotonic() - t_dispatch)
+                wav_dev = self._vocoder_exe[(bucket.b, t)](params, mel_out)
+                # one vectorized int16 conversion for the whole batch
+                # (the per-item numpy work is what bounds coalesced
+                # throughput on the CPU bench)
+                wavs = np.clip(
+                    np.asarray(wav_dev) * self.max_wav_value,
+                    -self.max_wav_value, self.max_wav_value - 1,
+                ).astype(np.int16)
+            else:
+                mel_host = np.asarray(mel_out)
+                synced = True
+                self._acoustic_hist.observe(time.monotonic() - t_dispatch)
+        finally:
+            # success path: the mel host sync proves the device is done
+            # with the staging buffers. Exception path: the transfers may
+            # still be in flight on a real accelerator, so pay one
+            # bounded wait before handing the buffers back.
+            if leases and not synced and dev:
+                try:
+                    import jax
+
+                    jax.block_until_ready(list(dev.values()))
+                except Exception:  # jaxlint: disable=JL007
+                    pass  # donated/failed arrays: nothing left reading
+            for buf in leases:
+                self.pool.release(buf)
 
         out_mel_lens = np.asarray(out["mel_lens"])
         durations = np.asarray(out["durations"])

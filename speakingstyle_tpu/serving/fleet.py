@@ -193,6 +193,11 @@ class FleetRouter:
             "serve_request_latency_seconds",
             help="request arrival -> result latency through the router",
         )
+        self._queue_wait_hist = self.registry.histogram(
+            "serve_queue_wait_seconds",
+            help="submit -> dispatch-start wait (the coalescing window "
+                 "the frontend pool overlaps with)",
+        )
         self._ttfa_hist = self.registry.histogram(
             "serve_ttfa_seconds",
             help="request arrival -> first streamed wav chunk ready",
@@ -366,6 +371,12 @@ class FleetRouter:
                 f"unknown priority class {klass!r}; configured classes: "
                 f"{sorted(self.fleet.class_deadline_ms)}"
             )
+        if getattr(req, "pending", False):
+            # a frontend handle (serving/frontend.py): class + deadline
+            # math need nothing beyond the handle; geometry waits for
+            # the resolved sequence and is validated at dispatch
+            # (_resolve_pending), where errors resolve the future
+            return klass
         if req.sequence.ndim != 1:
             raise ValueError(
                 f"request {req.id!r}: sequence must be [L], "
@@ -495,6 +506,10 @@ class FleetRouter:
     def _resolve_deadline_exceeded(self, p: _Pending) -> None:
         """Resolve one pending as DeadlineExceeded. Caller must already
         have removed it from the heap / any in-flight batch."""
+        if p.future.done():
+            # already resolved (a failed frontend resolution that was
+            # then stolen/requeued): the verdict is out, nothing to add
+            return
         self.registry.counter(
             "serve_deadline_exceeded_total", labels={"class": p.klass},
             help="requests resolved 504 instead of dispatched past their "
@@ -523,15 +538,52 @@ class FleetRouter:
             rep.dispatch_started = None
             return True
 
+    def _resolve_pending(self, p: _Pending) -> bool:
+        """Swap a frontend handle for its resolved SynthesisRequest in
+        place. False = the frontend raised (or wedged past the resolve
+        bound); the future already carries the error and the entry must
+        leave the batch."""
+        if not getattr(p.request, "pending", False):
+            return True
+        try:
+            request = p.request.resolve()
+            self._admit(request)   # geometry deferred from submit
+        except BaseException as e:
+            # the done-guard matters after a watchdog steal: a stolen
+            # entry whose resolution failed may come back through a
+            # requeue with its future already resolved
+            if not p.future.done():
+                p.future.set_exception(e)
+            return False
+        p.request = request
+        return True
+
     def _dispatch(self, rep: Replica, gen: int,
                   batch: List[_Pending]) -> bool:
         """Run one coalesced batch on the replica. Returns False when the
         replica failed (or its results were stolen by the hang watchdog)
         and the worker loop must exit — supervision owns the replica's
         state from that point."""
+        # resolve frontend handles before the device sees the batch.
+        # ``batch`` is also the replica's in-flight claim object (the
+        # watchdog handshake compares identity), so failed entries are
+        # removed IN PLACE and only under the router lock — the
+        # supervisor iterates this same list when it steals a hang
+        drop = [p for p in batch if not self._resolve_pending(p)]
+        if drop:
+            with self._cond:
+                if rep.inflight is not batch:
+                    return False  # stolen mid-resolve; supervisor owns it
+                for p in drop:
+                    batch.remove(p)
+        if not batch:
+            self._claim(rep, batch)   # nothing left to run: release it
+            return True
         req_ids = [p.request.id for p in batch]
         n = rep.dispatch_n        # stamped under the lock in _collect
         t0 = time.monotonic()
+        for p in batch:
+            self._queue_wait_hist.observe(t0 - p.request.arrival)
         try:
             if self.fault_plan is not None:
                 if self.fault_plan.fire("replica_raise", n):
@@ -660,6 +712,8 @@ class FleetRouter:
             ).inc()
             for p in batch:
                 budget = self.fleet.retry_budget.get(p.klass, 0)
+                if p.future.done():
+                    continue  # already resolved (failed frontend handle)
                 if self._closing:
                     shutdown.append(p)
                 elif now > p.slo_deadline:
@@ -816,7 +870,8 @@ class FleetRouter:
             )
         first = True
         for chunk in streaming.stream_wav(
-            engine, result, self.fleet.stream_window, self._stream_overlap
+            engine, result, self.fleet.stream_window, self._stream_overlap,
+            depth=self.fleet.stream_depth,
         ):
             if first and arrival is not None:
                 self._ttfa_hist.observe(time.monotonic() - arrival)

@@ -1,0 +1,236 @@
+"""Frontend worker pool: G2P overlapped with the dispatch queue wait.
+
+The host-side frontend work for a request — text normalization, G2P,
+per-word control expansion, style-cache lookup — is pure Python and
+runs tens of milliseconds for long utterances, all of it previously
+spent on the HTTP handler thread *before* the request entered the
+dispatch queue.  But the queue already makes every request wait: the
+batcher/router coalesces arrivals for up to ``serve.max_wait_ms``
+before dispatching.  Those two waits can overlap.
+
+``FrontendPool`` runs the frontend on a small worker pool
+(``serve.frontend_workers`` threads; 0 disables the pool and restores
+the inline pre-PR-11 behavior).  The HTTP handler mints a
+``PendingRequest`` — a submit-time stand-in that already knows
+everything admission needs (id, arrival stamp, SLO priority class,
+stream flag) — submits *that* to the dispatch backend, and only then
+enqueues the G2P work.  By the time the batcher/router pops the entry
+to dispatch, the frontend has usually resolved underneath the
+coalescing wait, so the serial path through a request drops by the
+frontend's cost.
+
+Semantics are unchanged by construction:
+
+  * **Deadline/shed.** The SLO clock starts at the handler's arrival
+    stamp (``PendingRequest.arrival``), exactly where the inline path
+    starts it; EDF expiry still resolves 504 pre-dispatch without ever
+    waiting on the frontend, and shed watermarks still act at submit.
+  * **Errors.** Frontend validation errors (bad text, unknown speaker,
+    wrong control arity) resolve the request's future exceptionally at
+    dispatch, surfacing as the same 400s the inline path raises —
+    only later.  Geometry (``RequestTooLarge``) moves from submit to
+    resolve for pooled requests, same verdict.
+  * **Zero device work.** Pool workers run pure-Python frontend code;
+    a style-cache *miss* with a raw reference still defers the encoder
+    to the engine's dispatch thread, so the zero-steady-state-compiles
+    invariant is untouched.
+
+``serve_frontend_seconds`` records the per-request frontend cost; the
+queue-side ``serve_queue_wait_seconds`` (batcher/fleet) records the
+submit->dispatch wait it hides under.
+"""
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, Optional
+
+from speakingstyle_tpu.obs import JsonlEventLog, MetricsRegistry
+from speakingstyle_tpu.obs.trace import Span
+from speakingstyle_tpu.serving.batcher import ShutdownError
+
+__all__ = ["PendingRequest", "FrontendPool", "RESOLVE_TIMEOUT_S"]
+
+# Bound on how long a dispatch worker will wait for a frontend handle
+# to resolve — far above any real G2P time; it exists only so a wedged
+# frontend worker cannot wedge the dispatch thread with it.  Expiry
+# resolves the future as TimeoutError (504), never blocks the batch.
+RESOLVE_TIMEOUT_S = 10.0
+
+
+class PendingRequest:
+    """Submit-time stand-in for a SynthesisRequest still in the frontend.
+
+    Quacks like the request for everything admission needs before G2P:
+    ``id``, ``arrival`` (the SLO clock origin), ``priority`` (the
+    payload's class string, type-checked here so a malformed class is
+    still a 400 at submit), and ``stream``.  ``resolve()`` blocks for
+    the real SynthesisRequest and re-raises any frontend error.  The
+    ``pending`` class attribute is the duck-type marker the dispatch
+    backends check — a resolved SynthesisRequest has no such attribute.
+    """
+
+    pending = True
+
+    def __init__(self, req_id: str, payload: Dict, stream: bool = False,
+                 arrival: Optional[float] = None):
+        priority = payload.get("priority")
+        if priority is not None and not isinstance(priority, str):
+            raise ValueError(
+                f"priority must be a class-name string, got "
+                f"{type(priority).__name__}"
+            )
+        self.id = req_id
+        self.payload = payload
+        self.stream = bool(stream)
+        self.priority = priority
+        self.arrival = time.monotonic() if arrival is None else arrival
+        self._future: Future = Future()
+
+    def resolve(self, timeout: Optional[float] = RESOLVE_TIMEOUT_S):
+        """Block for the resolved SynthesisRequest (or the frontend's
+        error). Idempotent — the result is cached in the future."""
+        return self._future.result(timeout=timeout)
+
+
+class FrontendPool:
+    """N daemon workers running TextFrontend.request off the HTTP path.
+
+    Two-phase producer API so no frontend work is wasted on a request
+    the backend refuses (shed/shutdown): ``prepare()`` mints the
+    handle, the caller submits it to the dispatch backend, and only a
+    successful submit is followed by ``dispatch()``.  ``close()``
+    flushes queued work, then fails anything that raced past the
+    sentinels with ``ShutdownError`` so no resolver is ever stranded.
+    """
+
+    def __init__(
+        self,
+        frontend,                 # TextFrontend (duck-typed in tests)
+        workers: int,
+        registry: Optional[MetricsRegistry] = None,
+        events: Optional[JsonlEventLog] = None,
+    ):
+        if workers < 1:
+            raise ValueError(f"FrontendPool needs >= 1 worker, got {workers}")
+        self.frontend = frontend
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self.events = events
+        # transitively bounded: dispatch() runs only after the backend
+        # accepted the handle, and backend admission sheds at its own
+        # queue_depth watermark — depth here can never exceed that bound
+        self._queue: "queue.SimpleQueue" = queue.SimpleQueue()  # jaxlint: disable=JL011
+        self._closed = False
+        self._close_lock = threading.Lock()
+        self._hist = self.registry.histogram(
+            "serve_frontend_seconds",
+            help="per-request frontend cost (normalize + G2P + style "
+                 "lookup) on the pool worker — overlapped with "
+                 "serve_queue_wait_seconds, not serial with it",
+        )
+        self._depth_gauge = self.registry.gauge(
+            "serve_frontend_queue_depth",
+            help="frontend handles awaiting a pool worker",
+        )
+        self._errors_ctr = self.registry.counter(
+            "serve_frontend_errors_total",
+            help="frontend resolutions that raised (surface as 400/500 "
+                 "when the dispatch backend pops the handle)",
+        )
+        self._threads = [
+            threading.Thread(
+                target=self._worker, name=f"frontend-{i}", daemon=True
+            )
+            for i in range(workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    # -- producer side ------------------------------------------------------
+
+    def prepare(self, req_id: str, payload: Dict,
+                stream: bool = False) -> PendingRequest:
+        """Mint the pending handle (cheap, raises only on a malformed
+        priority type). Does NOT enqueue work — call ``dispatch`` after
+        the backend accepted the handle."""
+        return PendingRequest(req_id, payload, stream=stream)
+
+    def dispatch(self, pending: PendingRequest) -> None:
+        """Enqueue the handle's frontend work. After close, resolves it
+        with ShutdownError instead (the backend flush then fails the
+        request's future with the same verdict the inline path gives)."""
+        with self._close_lock:
+            if self._closed:
+                pending._future.set_exception(
+                    ShutdownError("frontend pool is closed")
+                )
+                return
+            self._queue.put(pending)
+        self._depth_gauge.set(self._queue.qsize())
+
+    # -- worker side --------------------------------------------------------
+
+    def _worker(self) -> None:
+        while True:
+            try:
+                # a poll interval, not a bare wait: a lost sentinel can
+                # never strand the thread un-joinably
+                item = self._queue.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            if item is None:        # close sentinel
+                return
+            self._depth_gauge.set(self._queue.qsize())
+            try:
+                with Span("serve_frontend", registry=self.registry,
+                          events=self.events, req_id=item.id):
+                    request = self.frontend.request(item.id, item.payload)
+                    # the SLO clock and stream flag belong to the
+                    # handler's admission instant, not to when a worker
+                    # got around to the G2P — restamp so deadline math
+                    # matches inline mode
+                    request.stream = item.stream
+                    request.arrival = item.arrival
+            except BaseException as e:
+                self._errors_ctr.inc()
+                item._future.set_exception(e)
+            else:
+                item._future.set_result(request)
+            finally:
+                item.payload = None   # the handle may outlive the body
+
+    # -- shutdown -----------------------------------------------------------
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Idempotent: flush queued work, stop the workers, fail any
+        handle that raced in after the sentinels."""
+        with self._close_lock:
+            if self._closed:
+                return
+            self._closed = True
+            # sentinels queue BEHIND pending work: workers drain the
+            # flush, then exit — the prefetch/batcher discipline
+            for _ in self._threads:
+                self._queue.put(None)
+        for t in self._threads:
+            t.join(timeout=timeout)
+        # a dispatch() that won the closed-check race landed before the
+        # sentinels and was flushed; anything still queued here means a
+        # worker died — fail it rather than strand its resolver
+        while True:
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            if item is not None and not item._future.done():
+                item._future.set_exception(
+                    ShutdownError("frontend pool closed")
+                )
+
+    def __enter__(self) -> "FrontendPool":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

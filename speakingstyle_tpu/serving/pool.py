@@ -1,0 +1,117 @@
+"""Preallocated host staging buffers for the serve hot path.
+
+Every steady-state dispatch used to allocate its padded input arrays
+fresh (``np.zeros`` per ``vocode_window`` call, five staging arrays plus
+three control planes per ``SynthesisEngine.run``, one reference pad per
+style-encoder dispatch). On the latency floor those allocations are pure
+overhead — the shapes are the lattice's own bucket shapes, a closed set
+fixed at startup — and they put the allocator (and, eventually, the
+GC) on the tail. ``BufferPool`` replaces them with leased, preallocated
+per-``(shape, dtype)`` buffers: the first dispatch at a bucket allocates,
+every later one reuses.
+
+Ownership rules (the part that must survive the PR 9 failure paths):
+
+  * ``acquire`` hands the caller an exclusively-owned, freshly-filled
+    buffer; nobody else can see it until it is released.
+  * The caller releases only after the dispatch's **host sync point**
+    (``np.asarray`` of an output). ``jax.device_put`` copies on CPU but
+    is asynchronous on real accelerators — the transfer engine may still
+    be reading the host buffer until the computation that consumes it
+    completes — so release-after-sync is the portable contract.
+  * Release rides ``try/finally`` on every path: a faulted dispatch, a
+    stolen batch (the hang watchdog), or an abandoned stream must return
+    its buffers. ``release`` raises on double-release or on a buffer the
+    pool never leased, so a bookkeeping bug is loud, not a silent leak.
+
+The pool reports itself through the owning registry:
+``serve_pool_allocs_total`` (buffers ever created — flat after warmup is
+the allocation-free claim), ``serve_pool_reuses_total``, and the
+``serve_pool_outstanding`` gauge (0 when idle — the no-leak claim).
+"""
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from speakingstyle_tpu.obs import MetricsRegistry
+
+__all__ = ["BufferPool"]
+
+_Key = Tuple[Tuple[int, ...], str]
+
+
+class BufferPool:
+    """Thread-safe free-list of host ndarrays keyed by (shape, dtype)."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None else MetricsRegistry()
+        self._lock = threading.Lock()
+        self._free: Dict[_Key, List[np.ndarray]] = {}
+        # id(buf) -> (key, buf): holds the lease reference (keeps the id
+        # stable) and lets release() find the free-list without trusting
+        # the caller
+        self._leased: Dict[int, Tuple[_Key, np.ndarray]] = {}
+        self._allocs = self.registry.counter(
+            "serve_pool_allocs_total",
+            help="staging buffers ever created (flat after warmup = "
+                 "allocation-free steady state)",
+        )
+        self._reuses = self.registry.counter(
+            "serve_pool_reuses_total", help="staging buffer leases served "
+            "from the free list",
+        )
+        self._outstanding_g = self.registry.gauge(
+            "serve_pool_outstanding",
+            help="staging buffers currently leased (0 when idle = no leak)",
+        )
+
+    @staticmethod
+    def _key(shape, dtype) -> _Key:
+        return (tuple(int(s) for s in shape), np.dtype(dtype).str)
+
+    def acquire(self, shape, dtype=np.float32, fill: float = 0) -> np.ndarray:
+        """Lease a buffer of ``shape``/``dtype`` filled with ``fill``
+        (padding must be neutral, exactly as the np.zeros/np.ones it
+        replaces). Reuses a free buffer when one exists; allocates and
+        counts otherwise."""
+        key = self._key(shape, dtype)
+        with self._lock:
+            stack = self._free.get(key)
+            if stack:
+                buf = stack.pop()
+                self._reuses.inc()
+            else:
+                buf = np.empty(key[0], np.dtype(dtype))
+                self._allocs.inc()
+            self._leased[id(buf)] = (key, buf)
+            self._outstanding_g.inc()
+        buf.fill(fill)  # exclusive lease: no lock needed for the fill
+        return buf
+
+    def release(self, buf: np.ndarray) -> None:
+        """Return a leased buffer. Raises on double-release or a foreign
+        buffer — the exactly-once machinery upstream guarantees one
+        release per lease, and a violation is a bug worth crashing on."""
+        with self._lock:
+            entry = self._leased.pop(id(buf), None)
+            if entry is None:
+                raise ValueError(
+                    "release of a buffer this pool has not leased "
+                    "(double release, or a foreign array)"
+                )
+            key, _ = entry
+            self._free.setdefault(key, []).append(buf)
+            self._outstanding_g.dec()
+
+    @property
+    def allocated(self) -> int:
+        """Total buffers ever created (free + leased)."""
+        return int(self._allocs.value)
+
+    @property
+    def outstanding(self) -> int:
+        """Buffers currently leased; 0 when the serve path is idle."""
+        with self._lock:
+            return len(self._leased)

@@ -101,6 +101,7 @@ from speakingstyle_tpu.serving.batcher import (
     ShutdownError,
 )
 from speakingstyle_tpu.serving.engine import SynthesisEngine, SynthesisRequest
+from speakingstyle_tpu.serving.frontend import FrontendPool
 from speakingstyle_tpu.serving.lattice import RequestTooLarge
 from speakingstyle_tpu.serving.resilience import (
     DeadlineExceeded,
@@ -455,6 +456,18 @@ class SynthesisServer:
             self.batcher = ContinuousBatcher(engine, events=events)
             self.backend = self.batcher
         self.request_timeout = request_timeout
+        # frontend overlap (serving/frontend.py): with workers > 0 the
+        # handler submits a PendingRequest and the G2P runs on the pool,
+        # hidden under the backend's coalescing wait; 0 = inline frontend
+        # on the handler thread (the pre-pipeline behavior)
+        self.frontend_pool = (
+            FrontendPool(
+                frontend, serve.frontend_workers,
+                registry=self.registry, events=events,
+            )
+            if frontend is not None and serve.frontend_workers > 0
+            else None
+        )
         self.started = time.monotonic()
         self.profile_dir = profile_dir or os.path.join(
             self.cfg.train.path.log_path, "serve_profile"
@@ -799,6 +812,17 @@ class SynthesisServer:
                    stream: bool = False):
         if req_id is None:
             req_id = self.next_req_id()
+        if self.frontend_pool is not None:
+            # pipelined path: admission sees a PendingRequest stand-in
+            # (id/arrival/priority/stream are known pre-G2P) while the
+            # frontend resolves on a pool worker under the coalescing
+            # wait. prepare -> submit -> dispatch ordering matters: a
+            # shed/shutdown refusal at submit wastes no frontend work
+            pending = self.frontend_pool.prepare(req_id, payload,
+                                                 stream=stream)
+            future = self.backend.submit(pending)
+            self.frontend_pool.dispatch(pending)
+            return future.result(timeout=self._result_timeout(pending))
         request = self.frontend.request(req_id, payload)
         request.stream = stream   # mel-only dispatch; windows vocode after
         future = self.backend.submit(request)
@@ -845,7 +869,7 @@ class SynthesisServer:
         first = True
         for chunk in streaming.stream_wav(
             engine, result, self.cfg.serve.fleet.stream_window,
-            self._stream_overlap,
+            self._stream_overlap, depth=self.cfg.serve.fleet.stream_depth,
         ):
             if first and arrival is not None:
                 self._ttfa_hist.observe(time.monotonic() - arrival)
@@ -1027,4 +1051,8 @@ class SynthesisServer:
                 "shutdown_drain_timeout",
                 active_streams=int(self._streams_gauge.value),
             )
+        # backend first: its flush may still resolve pending frontend
+        # handles, so the pool must outlive the drain
         self.backend.close()
+        if self.frontend_pool is not None:
+            self.frontend_pool.close()

@@ -24,9 +24,26 @@ batch path proves.
 ``serve.fleet.stream_window`` sets the emitted frames per chunk;
 ``serve.fleet.stream_overlap`` sets the per-side context (0 derives the
 smallest exact overlap from the generator's topology).
+
+**The pipeline (PR 11).** ``stream_wav`` is a double-buffered
+producer–consumer over JAX async dispatch: window k+1 is *dispatched*
+(``engine.vocode_dispatch`` — pad into a pooled buffer, transfer,
+enqueue; returns immediately) before window k is *collected*
+(``engine.vocode_collect`` — the host sync plus trim/convert/emit).
+Steady-state chunk cadence is therefore max(device window time, host
+trim+emit) instead of their sum, and the emitted samples are bit-exact
+vs the sequential path — the pipeline reorders *waiting*, never the
+per-window math, and windows are still collected strictly in order.
+``serve.fleet.stream_depth`` bounds the windows in flight (1 = the old
+sequential behavior; 2 = double buffering, the default). If the
+consumer abandons the stream or a later dispatch faults mid-pipeline,
+the ``finally`` abandons every in-flight handle so its pooled buffer
+returns (serving/pool.py ownership rules) — no leak, and no chunk is
+ever emitted twice.
 """
 
 import math
+from collections import deque
 from typing import Iterator, Tuple
 
 import numpy as np
@@ -104,18 +121,51 @@ def stream_plan(
         )
 
 
-def stream_wav(engine, result, window: int, overlap: int) -> Iterator[np.ndarray]:
+def stream_wav(
+    engine, result, window: int, overlap: int, depth: int = 2
+) -> Iterator[np.ndarray]:
     """Yield int16 wav chunks for one SynthesisResult's mel, in order.
 
-    Each chunk is ``vocode_window`` of the overlap-padded span with the
-    overlap margins trimmed; concatenated chunks cover exactly
-    ``mel_len * hop`` samples. The per-chunk device work is one
-    precompiled vocoder dispatch — time-to-first-audio is bounded by the
-    first window, not the utterance length.
+    Each chunk is one overlap-padded window vocoded through the
+    precompiled lattice with the overlap margins trimmed; concatenated
+    chunks cover exactly ``mel_len * hop`` samples. Up to ``depth``
+    windows are in flight at once (dispatch k+1 before collecting k —
+    JAX async dispatch does the overlapping), so time-to-first-audio is
+    bounded by the first window and steady-state cadence by
+    max(device window, host trim+emit). ``depth=1`` restores the
+    strictly sequential dispatch→collect order; the output is identical
+    at any depth.
+
+    The mel is sliced per window straight off ``result.mel`` — no
+    full-utterance re-materialization; ``vocode_dispatch`` copies (and
+    dtype-converts) only the window into its pooled pad buffer.
     """
-    gen, _ = engine.vocoder
-    hop = gen.hop_factor
-    mel = np.asarray(result.mel, np.float32)
-    for start, end, lo, hi in stream_plan(int(result.mel_len), window, overlap):
-        wav = engine.vocode_window(mel[lo:hi])
-        yield wav[(start - lo) * hop: (end - lo) * hop]
+    if depth < 1:
+        raise ValueError(f"stream depth must be >= 1, got {depth}")
+    hop = int(engine.vocoder[0].hop_factor)
+    mel = result.mel
+    pending = deque()  # (handle, emit_start, emit_end, ctx_start)
+
+    def collect_one() -> np.ndarray:
+        handle, start, end, lo = pending.popleft()
+        wav = engine.vocode_collect(handle)
+        return wav[(start - lo) * hop: (end - lo) * hop]
+
+    try:
+        for start, end, lo, hi in stream_plan(
+            int(result.mel_len), window, overlap
+        ):
+            pending.append(
+                (engine.vocode_dispatch(mel[lo:hi]), start, end, lo)
+            )
+            if len(pending) >= depth:
+                yield collect_one()
+        while pending:
+            yield collect_one()
+    finally:
+        # consumer gone (GeneratorExit) or a dispatch/collect faulted:
+        # drain the in-flight handles so their pooled buffers return;
+        # nothing is emitted here, so exactly-once emission holds
+        while pending:
+            handle = pending.popleft()[0]
+            engine.vocode_abandon(handle)

@@ -57,6 +57,7 @@ from speakingstyle_tpu.faults import FaultPlan
 from speakingstyle_tpu.obs import MetricsRegistry
 from speakingstyle_tpu.obs.cost import ProgramCard, publish_program_gauges
 from speakingstyle_tpu.serving.lattice import StyleLattice
+from speakingstyle_tpu.serving.pool import BufferPool
 from speakingstyle_tpu.serving.resilience import InjectedFault
 
 __all__ = [
@@ -216,6 +217,9 @@ class StyleService:
         self._exe: Dict[Tuple[int, int], object] = {}
         self._cards: Dict[Tuple[int, int], ProgramCard] = {}
         self._compile_lock = threading.Lock()
+        # encoder-dispatch staging rides the same pooled-buffer
+        # discipline as the synthesis engine (serving/pool.py)
+        self.pool = BufferPool(registry=self.registry)
 
     # -- content addressing --------------------------------------------------
 
@@ -494,18 +498,24 @@ class StyleService:
                 self._compile_point(point)
         b, r = point
         t0 = time.monotonic()
-        padded = np.zeros((b, r, self.n_mels), np.float32)
-        lens = np.zeros((b,), np.int32)
-        for i, mel in enumerate(mels):
-            padded[i, : mel.shape[0]] = mel
-            lens[i] = mel.shape[0]
-        gammas_dev, betas_dev = self._exe[point](
-            self.variables, jax.device_put(padded), jax.device_put(lens)
-        )
-        # read back INSIDE the timed region: the histogram must measure
-        # device execution, not async enqueue (the JL010 discipline)
-        gammas = np.asarray(gammas_dev)
-        betas = np.asarray(betas_dev)
+        padded = self.pool.acquire((b, r, self.n_mels), np.float32)
+        lens = self.pool.acquire((b,), np.int32)
+        try:
+            for i, mel in enumerate(mels):
+                padded[i, : mel.shape[0]] = mel
+                lens[i] = mel.shape[0]
+            gammas_dev, betas_dev = self._exe[point](
+                self.variables, jax.device_put(padded), jax.device_put(lens)
+            )
+            # read back INSIDE the timed region: the histogram must
+            # measure device execution, not async enqueue (the JL010
+            # discipline) — and the sync is also what licenses the pool
+            # release below (serving/pool.py ownership rules)
+            gammas = np.asarray(gammas_dev)
+            betas = np.asarray(betas_dev)
+        finally:
+            self.pool.release(lens)
+            self.pool.release(padded)
         self._dispatches.inc()
         self.registry.histogram(
             "serve_style_encode_seconds",

@@ -832,6 +832,64 @@ def test_jl014_negative_outside_training_and_data():
 
 
 # ---------------------------------------------------------------------------
+# JL015 — fresh ndarray allocation in the serving hot path
+# ---------------------------------------------------------------------------
+
+
+def test_jl015_positive_alloc_in_dispatch_loop_and_handler():
+    src = """
+        import numpy as np
+
+        def _dispatch(batch):
+            out = []
+            for req in batch:
+                buf = np.zeros((4, 16), np.float32)
+                out.append(np.pad(req, (0, 4)))
+            return np.concatenate(out)
+    """
+    details = sorted({
+        f.detail for f in linter.lint_source(
+            textwrap.dedent(src), _SERVING_PATH
+        ) if f.rule == "JL015"
+    })
+    assert details == [
+        "np.concatenate in dispatch/handler function",
+        "np.pad in loop",
+        "np.zeros in loop",
+    ]
+
+
+def test_jl015_negative_precompile_and_pool_lease():
+    # startup allocation is sanctioned; the steady-state idiom leases a
+    # pooled buffer and writes in place
+    assert "JL015" not in _codes("""
+        import numpy as np
+
+        def precompile(lattice):
+            for point in lattice:
+                np.zeros(point.shape, np.float32)
+
+        def _dispatch(pool, batch, shape):
+            with pool.lease(shape) as buf:
+                np.copyto(buf[: len(batch)], 1.0)
+                return buf
+    """, path=_SERVING_PATH)
+
+
+def test_jl015_negative_outside_serving():
+    # a data loader may build fresh arrays per batch; only the serving
+    # hot path carries the allocation-free contract
+    assert "JL015" not in _codes("""
+        import numpy as np
+
+        def _dispatch(batch):
+            for b in batch:
+                buf = np.zeros((4,), np.float32)
+            return buf
+    """, path="speakingstyle_tpu/training/fake.py")
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -954,6 +1012,9 @@ def test_every_rule_is_non_vacuous():
     # JL014 is likewise deliberately absent: training/ and data/ already
     # device_put against NamedShardings only (the hard pins that remain
     # live in ops/ and obs/, outside the rule's scope on purpose).
+    # JL015 is absent because the PR that added it also moved every
+    # dispatch-loop staging allocation onto the BufferPool — the rule
+    # exists to keep it that way.
     for code in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
                  "JL007", "JL008"):
         assert code in fired, f"{code} never fires on the real tree"
@@ -991,11 +1052,14 @@ def test_cli_check_exits_zero_on_repo():
     ("JL013", "def serve(future):\n    return future.result()\n"),
     ("JL014", "import jax\n\ndef put(v):\n"
               "    return jax.device_put(v, jax.devices()[0])\n"),
+    ("JL015", "import numpy as np\n\ndef handle(reqs):\n    for r in reqs:\n"
+              "        buf = np.zeros((8,), np.float32)\n"),
 ])
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
     # JL004 is scoped to training/ paths; JL007 to speakingstyle_tpu/;
-    # JL011-JL013 to speakingstyle_tpu/serving/
-    sub = "serving" if code in ("JL011", "JL012", "JL013") else "training"
+    # JL011-JL013 and JL015 to speakingstyle_tpu/serving/
+    sub = ("serving" if code in ("JL011", "JL012", "JL013", "JL015")
+           else "training")
     d = tmp_path / "speakingstyle_tpu" / sub
     d.mkdir(parents=True)
     f = d / "fixture.py"

@@ -140,6 +140,9 @@ def test_snapshot_and_prometheus_text():
     assert snap["gauges"]["depth"] == 7
     hist = snap["histograms"]['lat_seconds{bucket="b1.s16.m32"}']
     assert hist["count"] == 1 and hist["buckets"][1.0] == 1
+    # tail keys: p999 rides every snapshot (min/max-tightened, so a
+    # single observation reports itself exactly)
+    assert hist["p999"] == 0.5 and hist["max"] == 0.5
 
     text = reg.prometheus_text()
     assert "# HELP req_total requests" in text
@@ -149,6 +152,17 @@ def test_snapshot_and_prometheus_text():
     assert 'lat_seconds_bucket{bucket="b1.s16.m32",le="0.1"} 0' in text
     assert 'lat_seconds_bucket{bucket="b1.s16.m32",le="+Inf"} 1' in text
     assert 'lat_seconds_count{bucket="b1.s16.m32"} 1' in text
+    assert 'lat_seconds_p999{bucket="b1.s16.m32"} 0.5' in text
+    assert 'lat_seconds_max{bucket="b1.s16.m32"} 0.5' in text
+
+
+def test_prometheus_text_skips_tail_lines_on_empty_histogram():
+    reg = MetricsRegistry()
+    reg.histogram("idle_seconds", edges=(0.1, 1.0))
+    text = reg.prometheus_text()
+    assert "idle_seconds_count 0" in text
+    assert "idle_seconds_p999" not in text
+    assert "idle_seconds_max" not in text
 
 
 def test_default_registry_is_a_singleton():

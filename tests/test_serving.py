@@ -334,7 +334,10 @@ def test_batcher_stats_are_registry_views():
 
 
 def test_fill_control_scalar_and_per_phoneme():
-    out = _fill_control([2.0, np.asarray([3.0, 4.0], np.float32)], 3, 4)
+    # the engine leases the buffer from its pool pre-filled with the
+    # neutral 1.0; _fill_control only writes the real rows' prefixes
+    out = np.ones((3, 4), np.float32)
+    _fill_control([2.0, np.asarray([3.0, 4.0], np.float32)], out)
     np.testing.assert_allclose(out[0], [2, 2, 2, 2])
     np.testing.assert_allclose(out[1], [3, 4, 1, 1])
     np.testing.assert_allclose(out[2], [1, 1, 1, 1])  # padding row neutral
