@@ -1662,6 +1662,310 @@ def run_chaos(duration: float = 3.0, clients: int = 16,
     return point
 
 
+def run_traffic(duration: float = 4.0, base_qps: float = 12.0,
+                device_ms: float = 40.0, chaos: bool = True, seed: int = 0):
+    """Capacity-planning storm: a seeded production-shaped workload
+    (serving/traffic.py) replayed open-loop against an AUTOSCALED fleet.
+
+    One schedule, four acts on the same clock: a steady phase at the
+    base rate (one replica, right-sized), a 10x flash crowd that builds
+    queue until the closed-loop autoscaler grows the fleet — with a
+    chaos ``replica_raise`` armed mid-flash so a replica dies inside the
+    storm — then a recovery window at base rate while cold replicas
+    finish joining, and finally a drain where calm shrinks the fleet
+    back to the floor. Every submitted request is tracked to a terminal
+    state, so the lost count is exact and its invariant is ZERO: flash
+    overload must resolve as shed-with-Retry-After or served-late, never
+    as silent loss. CompileMonitor spans the steady phase (scale-up
+    warm-ups are the sanctioned compile window, as in run_chaos).
+
+    The emitted record is the capacity artifact: QPS/replica at the
+    base rate, shed fraction and scale-up reaction through the flash,
+    the measured cost of a replica joining mid-storm, and the policy's
+    decision tally by reason.
+    """
+    import dataclasses
+
+    import numpy as np
+
+    import jax
+
+    from speakingstyle_tpu.configs.config import AutoscaleConfig, FleetConfig
+    from speakingstyle_tpu.faults import FaultPlan
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.models.hifigan import Generator
+    from speakingstyle_tpu.obs import MetricsRegistry
+    from speakingstyle_tpu.serving.autoscale import Autoscaler
+    from speakingstyle_tpu.serving.batcher import Overloaded
+    from speakingstyle_tpu.serving.engine import (
+        CompileMonitor,
+        SynthesisEngine,
+        SynthesisRequest,
+    )
+    from speakingstyle_tpu.serving.fleet import FAILED, FleetRouter
+    from speakingstyle_tpu.serving.style import StyleService
+    from speakingstyle_tpu.serving.traffic import TrafficModel
+
+    on_tpu = _is_tpu(jax.devices()[0])
+    if on_tpu:
+        device_ms = 0.0
+    label = "tiny-cpu-proxydev" if device_ms > 0 else (
+        "flagship" if on_tpu else "tiny-cpu"
+    )
+    _mark("building traffic fleet parts")
+    cfg = _fleet_proxy_config()
+    # generous deadlines (the storm deliberately builds multi-second
+    # backlog; expiry must not masquerade as loss) + an armed autoscaler
+    # sized for the drill: floor 1, ceiling 3, ticks and calm windows in
+    # bench seconds
+    min_replicas, max_replicas = 1, 3
+    cfg = dataclasses.replace(cfg, serve=dataclasses.replace(
+        cfg.serve,
+        fleet=FleetConfig(
+            stream_window=8, queue_depth=256,
+            class_deadline_ms={"interactive": 60_000.0, "batch": 120_000.0},
+            rewarm_backoff_s=0.2, rewarm_backoff_max_s=5.0,
+        ),
+        autoscale=AutoscaleConfig(
+            enabled=True, min_replicas=min_replicas,
+            max_replicas=max_replicas, interval_s=0.05,
+            up_queue_fraction=0.25, up_occupancy=0.95,
+            up_pressure_rate=50.0, down_queue_fraction=0.05,
+            down_occupancy=0.5, down_stable_s=1.0, cooldown_up_s=1.0,
+            cooldown_down_s=1.0, max_step=2, assumed_warmup_s=5.0,
+            warmup_cost_factor=0.5,
+        ),
+    ))
+    serve = cfg.serve
+    # the storm: steady (1 phase), flash (1 phase at 10x), recovery
+    # (2 phases at base while cold capacity lands and backlog drains)
+    flash_start, flash_end = duration, 2.0 * duration
+    total_s = 4.0 * duration
+    model_traffic = TrafficModel(
+        seed=seed, base_qps=base_qps, duration_s=total_s,
+        diurnal_floor=0.8, flash_windows=[(flash_start, flash_end)],
+        flash_multiplier=10.0, n_styles=32, zipf_s=1.2,
+    )
+    schedule = model_traffic.schedule()
+
+    n_position = max(serve.mel_buckets[-1], serve.src_buckets[-1],
+                     cfg.model.max_seq_len) + 1
+    model = build_model(cfg, n_position=n_position)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    gen = Generator(
+        upsample_rates=(2, 2), upsample_kernel_sizes=(4, 4),
+        upsample_initial_channel=16, resblock_kernel_sizes=(3,),
+        resblock_dilation_sizes=((1,),),
+    )
+    n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+    gparams = gen.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8, n_mels), np.float32)
+    )["params"]
+    rng = np.random.default_rng(seed)
+    max_len = min(serve.src_buckets[-1],
+                  serve.mel_buckets[-1] // serve.frames_per_phoneme)
+    max_ref = serve.style.ref_buckets[-1]
+    # one ref per zipf style rank: the hot ranks hammer the embedding
+    # cache exactly as a real catalog's head voices do
+    style_refs = [
+        rng.standard_normal(
+            (int(rng.integers(8, max_ref + 1)), n_mels)
+        ).astype(np.float32)
+        for _ in range(model_traffic.n_styles)
+    ]
+    sequences = [rng.integers(1, 300, max_len).astype(np.int32)
+                 for _ in range(16)]
+
+    def make_request(i: int, ev) -> SynthesisRequest:
+        L = max(4, int(round(ev.length_frac * max_len)))
+        return SynthesisRequest(
+            id=f"traffic{i}",
+            sequence=sequences[i % len(sequences)][:L],
+            ref_mel=style_refs[ev.style],
+            priority=ev.priority,
+        )
+
+    registry = MetricsRegistry()
+    plan = FaultPlan()
+    shared_style = StyleService(cfg, variables, registry=registry)
+
+    def factory(reg):
+        return ProxyDeviceEngine(
+            SynthesisEngine(
+                cfg, variables, vocoder=(gen, gparams), model=model,
+                registry=reg, style=shared_style,
+            ),
+            device_ms,
+        )
+
+    _mark("warming 1 traffic replica")
+    router = FleetRouter(factory, cfg, replicas=min_replicas,
+                         registry=registry, style=shared_style,
+                         fault_plan=plan)
+    if not router.wait_ready(timeout=600, n=min_replicas):
+        print(json.dumps({
+            "metric": "serve_traffic", "error": "replica never became ready",
+            "model": label,
+        }))
+        router.close()
+        return None
+    for engine in router.engines():
+        for b in engine.lattice.batch_buckets:
+            engine.run([make_request(10_000_000 + b * 100 + j, schedule[0])
+                        for j in range(b)])
+
+    def phase_of(t: float) -> str:
+        if t < flash_start:
+            return "steady"
+        if t < flash_end:
+            return "flash"
+        return "recovery"
+
+    counts = {p: dict(ok=0, shed=0, lost=0, errors=[])
+              for p in ("steady", "flash", "recovery")}
+    pending = []  # (future, phase)
+    timeline = {}
+    peak = [min_replicas]
+    stop_mon = threading.Event()
+    scaler = Autoscaler(router, serve.autoscale)
+
+    def monitor():
+        # bounds witness + reaction/fault timestamps, sampled through
+        # the whole storm
+        while not stop_mon.wait(0.005):
+            live = router.live_replica_count()
+            peak[0] = max(peak[0], live)
+            now = time.perf_counter()
+            if scaler.target > min_replicas and "t_first_up" not in timeline:
+                timeline["t_first_up"] = now
+            states = list(router.states().values())
+            if FAILED in states:
+                timeline.setdefault("t_failed", now)
+            elif "t_failed" in timeline:
+                timeline.setdefault("t_recovered", now)
+
+    mon_thread = threading.Thread(target=monitor, daemon=True)
+    mon_thread.start()
+
+    _mark(f"replaying {len(schedule)} arrivals over {total_s:.0f}s "
+          f"(flash {flash_start:.0f}-{flash_end:.0f}s)")
+    steady_mon = CompileMonitor()
+    steady_mon.__enter__()
+    steady_done = False
+    chaos_armed = False
+    t0 = time.perf_counter()
+    for i, ev in enumerate(schedule):
+        delay = t0 + ev.t - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        if not steady_done and ev.t >= flash_start:
+            steady_mon.__exit__(None, None, None)
+            steady_done = True
+            timeline["t_flash_start"] = t0 + flash_start
+        if chaos and not chaos_armed \
+                and ev.t >= 0.5 * (flash_start + flash_end):
+            # mid-flash chaos: the NEXT dispatch raises in a replica —
+            # supervision re-warms it while the autoscaler is growing
+            plan.arm("replica_raise", router.dispatch_total + 1)
+            chaos_armed = True
+        p = phase_of(ev.t)
+        try:
+            pending.append((router.submit(make_request(i, ev)), p))
+        except Overloaded:
+            counts[p]["shed"] += 1
+        except Exception as e:
+            counts[p]["lost"] += 1
+            counts[p]["errors"].append(type(e).__name__)
+    if not steady_done:
+        steady_mon.__exit__(None, None, None)
+    _mark(f"storm submitted; awaiting {len(pending)} admitted requests")
+    for fut, p in pending:
+        try:
+            fut.result(timeout=300)
+            counts[p]["ok"] += 1
+        except Exception as e:
+            counts[p]["lost"] += 1
+            counts[p]["errors"].append(type(e).__name__)
+
+    # post-storm: calm should shrink the fleet back to the floor; the
+    # wait bound covers the calm window (scaled by the measured warm-up
+    # cost) plus the down cooldown
+    _mark("draining: waiting for scale-down to the floor")
+    shrink_deadline = time.monotonic() + 120
+    while time.monotonic() < shrink_deadline:
+        if router.live_replica_count() <= min_replicas:
+            break
+        time.sleep(0.1)
+    scaled_down = router.live_replica_count() <= min_replicas
+    stop_mon.set()
+    mon_thread.join(timeout=5)
+    scaler.close()
+    warmup_p50 = router.warmup_cost_s()
+    router.close()
+
+    # reaction = flash start -> first scale-up decision; meaningful only
+    # when the first up actually fired inside the storm
+    reaction_ms = None
+    if "t_first_up" in timeline and "t_flash_start" in timeline \
+            and timeline["t_first_up"] >= timeline["t_flash_start"]:
+        reaction_ms = round(
+            1e3 * (timeline["t_first_up"] - timeline["t_flash_start"]), 1
+        )
+    fault_recovery_ms = None
+    if "t_failed" in timeline and "t_recovered" in timeline:
+        fault_recovery_ms = round(
+            1e3 * (timeline["t_recovered"] - timeline["t_failed"]), 1
+        )
+    decisions = {}
+    for key, count in registry.snapshot()["counters"].items():
+        if key.startswith("serve_autoscale_decisions_total{"):
+            reason = key.split('reason="', 1)[1].split('"', 1)[0]
+            decisions[reason] = int(count)
+    flash_offered = sum(counts["flash"][k] for k in ("ok", "shed", "lost"))
+    flash_shed_fraction = (
+        round(counts["flash"]["shed"] / flash_offered, 4)
+        if flash_offered else None
+    )
+    lost = sum(counts[p]["lost"] for p in counts)
+    point = {
+        "metric": "serve_traffic",
+        "workload": model_traffic.describe(),
+        "offered": len(schedule),
+        "phases": {
+            p: {k: counts[p][k] for k in ("ok", "shed", "lost")}
+            for p in counts
+        },
+        "errors": sorted({e for p in counts for e in counts[p]["errors"]}),
+        "lost_requests": lost,
+        "qps_per_replica_steady": round(
+            counts["steady"]["ok"] / duration / min_replicas, 2
+        ),
+        "qps_per_replica_flash": round(
+            counts["flash"]["ok"] / duration / peak[0], 2
+        ),
+        "flash_shed_fraction": flash_shed_fraction,
+        "scaleup_reaction_ms": reaction_ms,
+        "replicas_peak": peak[0],
+        "replicas_max": max_replicas,
+        "scaled_down_to_floor": scaled_down,
+        "warmup_cost_s": round(warmup_p50, 3) if warmup_p50 else None,
+        "steady_compiles": steady_mon.count,
+        "chaos_armed": chaos_armed,
+        "chaos_recovery_ms": fault_recovery_ms,
+        "replica_failures": sum(
+            int(registry.value("serve_replica_failures_total",
+                               {"replica": str(i)}))
+            for i in range(max_replicas + 2)
+        ),
+        "requeued": int(registry.value("serve_requeued_total")),
+        "autoscale_decisions": decisions,
+        "proxy_device_ms": device_ms,
+        "model": label,
+    }
+    print(json.dumps(point))
+    return point
+
+
 def run_ab():
     """A/B the performance knobs (README "Performance knobs"): one process
     per variant so each gets a clean backend; prints one JSON line each."""
@@ -1921,6 +2225,27 @@ def _absorb_record(rec, metrics):
                                               "lower")
         if isinstance(rec.get("shed"), (int, float)):
             metrics["chaos_shed"] = (float(rec["shed"]), "lower")
+    elif m == "serve_traffic":
+        # the capacity storm's SLO numbers; lost_requests carries the
+        # same hard zero gate as the chaos drill in run_compare
+        if isinstance(rec.get("qps_per_replica_steady"), (int, float)):
+            metrics["traffic_qps_per_replica_steady"] = (
+                float(rec["qps_per_replica_steady"]), "higher")
+        if isinstance(rec.get("qps_per_replica_flash"), (int, float)):
+            metrics["traffic_qps_per_replica_flash"] = (
+                float(rec["qps_per_replica_flash"]), "higher")
+        if isinstance(rec.get("flash_shed_fraction"), (int, float)):
+            metrics["traffic_flash_shed_fraction"] = (
+                float(rec["flash_shed_fraction"]), "lower")
+        if isinstance(rec.get("scaleup_reaction_ms"), (int, float)):
+            metrics["traffic_scaleup_reaction_ms"] = (
+                float(rec["scaleup_reaction_ms"]), "lower")
+        if isinstance(rec.get("lost_requests"), (int, float)):
+            metrics["traffic_lost_requests"] = (
+                float(rec["lost_requests"]), "lower")
+        if isinstance(rec.get("steady_compiles"), (int, float)):
+            metrics["traffic_steady_compiles"] = (
+                float(rec["steady_compiles"]), "lower")
     elif m == "train_multichip":
         n = rec.get("n_devices")
         if isinstance(rec.get("frames_per_sec_per_chip"), (int, float)):
@@ -2010,6 +2335,15 @@ def run_compare(old_path, new_path=None, threshold=REGRESSION_THRESHOLD,
         print(f"FAIL: chaos drill lost {int(lost[0])} request(s) in "
               f"{os.path.basename(new_path)}; supervision must requeue "
               "or structurally resolve every in-flight request", file=out)
+        return 1
+    # same zero gate for the traffic storm: flash overload must resolve
+    # as shed (429 + Retry-After) or served-late, never as silent loss
+    lost = new.get("traffic_lost_requests")
+    if lost is not None and lost[0] > 0:
+        print(f"FAIL: traffic storm lost {int(lost[0])} request(s) in "
+              f"{os.path.basename(new_path)}; every admitted request "
+              "must reach a terminal state through flash + chaos + "
+              "scale-down", file=out)
         return 1
     common = sorted(set(old) & set(new))
     if not common:
@@ -2132,6 +2466,11 @@ if __name__ == "__main__":
         run_fleet(duration=dur)
         run_style(duration=dur)
         run_chaos(duration=dur)
+        run_traffic(duration=dur)
+    elif "--traffic" in sys.argv:
+        dur = (float(sys.argv[sys.argv.index("--duration") + 1])
+               if "--duration" in sys.argv else 4.0)
+        run_traffic(duration=dur)
     elif "--latency" in sys.argv:
         dur = (float(sys.argv[sys.argv.index("--duration") + 1])
                if "--duration" in sys.argv else 3.0)

@@ -57,6 +57,11 @@ JL015  fresh ndarray allocation in the serving hot path: np.zeros/
        is allocation-free by contract (per-bucket BufferPool leases,
        serving/pool.py); a per-request allocation puts malloc and
        page-zeroing jitter straight into the p999
+JL016  bare time.sleep() inside a loop under speakingstyle_tpu/serving/
+       — supervision/policy loops (the fleet supervisor, the
+       autoscaler) must park on a stop-aware Event.wait(timeout) or
+       Condition.wait so close()/drain interrupts them immediately; a
+       sleeping thread holds shutdown hostage for up to a full tick
 """
 
 import ast
@@ -1766,6 +1771,52 @@ def rule_jl015(mod: ModuleInfo) -> Iterator[Finding]:
         )
 
 
+_SLEEP_CALLS = {"time.sleep", "sleep"}
+
+
+def rule_jl016(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL016: bare ``time.sleep()`` in a loop under
+    ``speakingstyle_tpu/serving/`` — supervision/policy loops must park
+    on a stop-aware wait.
+
+    Serving-side background loops (the fleet supervisor's watchdog
+    sweep, the autoscaler's policy tick, re-warm backoff) all follow one
+    idiom: block on ``Event.wait(timeout)`` or ``Condition.wait(timeout)``
+    so that ``close()`` can set/notify and the thread exits NOW, not up
+    to a full tick later. A bare ``time.sleep`` in such a loop is
+    uninterruptible — drain and shutdown inherit its latency, and a
+    SIGTERM'd process misses its drain deadline because a policy thread
+    was napping. One-shot sleeps outside loops (a close-path settle, an
+    injected fault's deliberate stall) are not supervision cadence and
+    are not flagged.
+    """
+    p = mod.path.replace("\\", "/")
+    if "speakingstyle_tpu/serving/" not in p:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _dotted(node.func) not in _SLEEP_CALLS:
+            continue
+        if not mod.enclosing_loops(node):
+            continue
+        qual = mod.qualname(node)
+        yield Finding(
+            rule="JL016",
+            path=mod.path,
+            line=node.lineno,
+            context=qual,
+            detail="time.sleep in loop",
+            message=(
+                f"`time.sleep` inside a loop ({qual}): a supervision/"
+                "policy loop must park on a stop-aware "
+                "`Event.wait(timeout)` (or `Condition.wait`) so close()/"
+                "drain interrupts it immediately — a bare sleep holds "
+                "shutdown hostage for up to a full tick."
+            ),
+        )
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -1782,4 +1833,5 @@ RULES = {
     "JL013": rule_jl013,
     "JL014": rule_jl014,
     "JL015": rule_jl015,
+    "JL016": rule_jl016,
 }

@@ -178,6 +178,7 @@ def main(args):
             max_bytes=cfg.train.obs.events_max_bytes,
             keep=cfg.train.obs.events_keep,
         )
+    autoscaler = None
     if replicas > 1:
         # fleet mode: load the checkpoint once, warm replicas on
         # background threads (persistent compile cache makes scale-up
@@ -220,6 +221,16 @@ def main(args):
             "points in the background (healthz: 503 until ready) ...",
             flush=True,
         )
+        if cfg.serve.autoscale.enabled:
+            from speakingstyle_tpu.serving.autoscale import Autoscaler
+
+            acfg = cfg.serve.autoscale
+            autoscaler = Autoscaler(router, acfg)
+            print(
+                f"autoscaler armed: [{acfg.min_replicas}, "
+                f"{acfg.max_replicas}] replicas, tick {acfg.interval_s}s "
+                f"(serve_autoscale_target tracks decisions)", flush=True,
+            )
         server = SynthesisServer(
             frontend=TextFrontend(cfg, default_ref),
             host=args.host,
@@ -278,6 +289,10 @@ def main(args):
     except KeyboardInterrupt:
         print("shutting down (flushing admitted requests) ...", flush=True)
     finally:
+        # stop the policy loop before the drain: a scale decision
+        # landing mid-shutdown would race the router's own teardown
+        if autoscaler is not None:
+            autoscaler.close()
         server.shutdown()
         if events is not None:
             events.close()

@@ -664,6 +664,117 @@ class FleetConfig:
 
 
 @dataclass(frozen=True)
+class AutoscaleConfig:
+    """Closed-loop fleet autoscaler knobs (serving/autoscale.py —
+    ARCHITECTURE.md "Autoscaling & traffic model").
+
+    Disabled by default: with ``enabled: false`` nothing changes — the
+    replica count stays wherever ``scale_to()`` last put it. Enabled, a
+    policy thread watches the signals the router already exports
+    (pending-heap depth vs the shed watermarks, shed/deadline-miss
+    rates, per-replica dispatch occupancy) and drives ``scale_to()``
+    inside ``[min_replicas, max_replicas]`` with hysteresis and
+    cooldowns. The scale-up cost model is MEASURED, not assumed: the
+    ``serve_replica_warmup_seconds`` histogram (sampled from actual
+    replica warm-ups through the persistent compile cache) stretches
+    both the post-scale-up cooldown and the calm window required before
+    shedding capacity again.
+    """
+
+    enabled: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 4
+    # policy tick period; the loop is a stop-aware Event.wait, never a
+    # bare time.sleep (jaxlint JL016), so drain/shutdown is not blocked
+    interval_s: float = 0.25
+    # -- scale-up triggers (any one fires) --
+    # pending-heap depth as a fraction of fleet.queue_depth; sits below
+    # shed_high_watermark on purpose — capacity should grow BEFORE the
+    # router starts shedding
+    up_queue_fraction: float = 0.5
+    # instantaneous busy fraction of READY replicas; only fires with a
+    # backlog at least one-deep per live replica (floor 2) SUSTAINED
+    # for a full tick — a single mid-dispatch snapshot is not pressure
+    up_occupancy: float = 0.9
+    # shed + deadline-miss events per second over the last tick
+    up_pressure_rate: float = 1.0
+    # -- scale-down (all must hold, sustained) --
+    down_queue_fraction: float = 0.05
+    down_occupancy: float = 0.5
+    # calm must persist this long (stretched by the measured warm-up
+    # cost, see warmup_cost_factor) before one replica is drained
+    down_stable_s: float = 5.0
+    # -- hysteresis / bounds --
+    cooldown_up_s: float = 2.0
+    cooldown_down_s: float = 10.0
+    # replicas added per scale-up decision at extreme pressure (depth
+    # past twice the up watermark); ordinary pressure adds one
+    max_step: int = 2
+    # cost model: assumed warm-up seconds until the first measured
+    # sample lands in serve_replica_warmup_seconds
+    assumed_warmup_s: float = 10.0
+    # the calm window before a scale-down is max(down_stable_s,
+    # warmup_cost_factor * measured-warmup): capacity that was expensive
+    # to warm is held longer against oscillating load
+    warmup_cost_factor: float = 1.0
+
+    def __post_init__(self):
+        if self.min_replicas < 1:
+            raise ValueError(
+                f"autoscale.min_replicas must be >= 1, got {self.min_replicas}"
+            )
+        if self.max_replicas < self.min_replicas:
+            raise ValueError(
+                "autoscale.max_replicas must be >= min_replicas, got "
+                f"{self.max_replicas} < {self.min_replicas}"
+            )
+        if self.interval_s <= 0:
+            raise ValueError(
+                f"autoscale.interval_s must be > 0, got {self.interval_s}"
+            )
+        if not (0.0 < self.up_queue_fraction <= 1.0):
+            raise ValueError(
+                "autoscale.up_queue_fraction must be in (0, 1], got "
+                f"{self.up_queue_fraction}"
+            )
+        if not (0.0 <= self.down_queue_fraction < self.up_queue_fraction):
+            raise ValueError(
+                "autoscale.down_queue_fraction must satisfy 0 <= down < "
+                f"up_queue_fraction, got {self.down_queue_fraction}"
+            )
+        if not (0.0 < self.up_occupancy <= 1.0):
+            raise ValueError(
+                "autoscale.up_occupancy must be in (0, 1], got "
+                f"{self.up_occupancy}"
+            )
+        if not (0.0 <= self.down_occupancy < self.up_occupancy):
+            raise ValueError(
+                "autoscale.down_occupancy must satisfy 0 <= down < "
+                f"up_occupancy, got {self.down_occupancy}"
+            )
+        if self.up_pressure_rate < 0:
+            raise ValueError(
+                "autoscale.up_pressure_rate must be >= 0, got "
+                f"{self.up_pressure_rate}"
+            )
+        for name in ("down_stable_s", "cooldown_up_s", "cooldown_down_s",
+                     "warmup_cost_factor"):
+            if getattr(self, name) < 0:
+                raise ValueError(
+                    f"autoscale.{name} must be >= 0, got {getattr(self, name)}"
+                )
+        if self.max_step < 1:
+            raise ValueError(
+                f"autoscale.max_step must be >= 1, got {self.max_step}"
+            )
+        if self.assumed_warmup_s <= 0:
+            raise ValueError(
+                "autoscale.assumed_warmup_s must be > 0, got "
+                f"{self.assumed_warmup_s}"
+            )
+
+
+@dataclass(frozen=True)
 class StyleConfig:
     """Style-service knobs (serving/style.py — ARCHITECTURE.md "Style
     service").
@@ -764,6 +875,8 @@ class ServeConfig:
     frontend_workers: int = 2
     # fleet serving: multi-replica router, SLO admission, streaming
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    # closed-loop autoscaler over the fleet (disabled by default)
+    autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
     # style service: AOT reference-encoder lattice + embedding cache
     style: StyleConfig = field(default_factory=StyleConfig)
 

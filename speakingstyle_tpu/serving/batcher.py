@@ -25,7 +25,7 @@ in-flight futures rather than stranding their waiters.
 import queue
 import threading
 import time
-from collections import Counter
+from collections import Counter, deque
 from concurrent.futures import Future
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
@@ -56,6 +56,55 @@ class Overloaded(RuntimeError):
     def __init__(self, message: str, retry_after_s: float = 1.0):
         super().__init__(message)
         self.retry_after_s = float(retry_after_s)
+
+
+class DrainRateEstimator:
+    """Sliding-window estimate of queue drain throughput (requests/s).
+
+    Both admission paths (batcher queue, fleet EDF heap) feed completed
+    requests into one of these so a 429's Retry-After can be DERIVED —
+    "seconds until the queue drains back to the low watermark at the
+    current service rate" — instead of advertising a constant that makes
+    every shed client retry in lockstep. The rate divides by the full
+    window (not the observed span), which deliberately under-estimates
+    while the window is still filling: an under-estimated rate is an
+    over-estimated Retry-After, the conservative direction under load.
+    """
+
+    def __init__(self, window_s: float = 5.0):
+        self.window_s = float(window_s)
+        self._lock = threading.Lock()
+        self._events: "deque" = deque()  # (monotonic stamp, n completed)
+
+    def note(self, n: int = 1, now: Optional[float] = None) -> None:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._events.append((now, n))
+            self._trim(now)
+
+    def _trim(self, now: float) -> None:
+        horizon = now - self.window_s
+        while self._events and self._events[0][0] < horizon:
+            self._events.popleft()
+
+    def rate(self, now: Optional[float] = None) -> float:
+        """Completed requests per second over the window; 0.0 before any
+        completion has been observed (callers fall back to the
+        configured constant)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._trim(now)
+            total = sum(n for _, n in self._events)
+        return total / self.window_s
+
+    def retry_after(self, backlog: float, fallback: float,
+                    lo: float = 0.1, hi: float = 30.0) -> float:
+        """Seconds until ``backlog`` requests drain at the current rate,
+        clamped to [lo, hi]; ``fallback`` when no rate is measured yet."""
+        r = self.rate()
+        if r <= 0.0:
+            return fallback
+        return min(max(backlog / r, lo), hi)
 
 
 @dataclass
@@ -97,6 +146,7 @@ class ContinuousBatcher:
             fleet.shed_low_watermark * self._depth if fleet else 0
         )
         self._retry_after = fleet.shed_retry_after_s if fleet else 1.0
+        self.drain_rate = DrainRateEstimator()
         self._shedding = False
         self._shed_lock = threading.Lock()
         self._stopped = threading.Event()
@@ -183,10 +233,16 @@ class ContinuousBatcher:
             shedding = self._shedding
         if shedding:
             self._shed_ctr.inc()
+            # Retry-After derives from the measured drain rate over the
+            # hysteresis gap (depth back down to the low watermark, where
+            # admission resumes); the configured constant is only the
+            # fallback before any dispatch has completed
             raise Overloaded(
                 f"admission queue at {depth}/{self._depth} (high watermark "
                 f"{self._shed_high:g}): shedding load",
-                retry_after_s=self._retry_after,
+                retry_after_s=self.drain_rate.retry_after(
+                    max(depth - self._shed_low, 1.0), self._retry_after
+                ),
             )
 
     def refresh_gauges(self) -> None:
@@ -341,6 +397,10 @@ class ContinuousBatcher:
                 self.refresh_gauges()
                 if batch:
                     self._dispatch(batch)
+                    # every entry left the queue with a resolved future
+                    # (result, engine error, or DispatchError): all of it
+                    # is drain the Retry-After estimate should see
+                    self.drain_rate.note(len(batch))
                 if terminal:
                     return
         except BaseException as e:  # engine + bookkeeping errors are

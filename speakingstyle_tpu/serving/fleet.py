@@ -60,7 +60,11 @@ import numpy as np
 from speakingstyle_tpu.faults import FaultPlan
 from speakingstyle_tpu.obs import JsonlEventLog, MetricsRegistry
 from speakingstyle_tpu.serving import streaming
-from speakingstyle_tpu.serving.batcher import Overloaded, ShutdownError
+from speakingstyle_tpu.serving.batcher import (
+    DrainRateEstimator,
+    Overloaded,
+    ShutdownError,
+)
 from speakingstyle_tpu.serving.engine import (
     SynthesisEngine,
     SynthesisRequest,
@@ -206,6 +210,18 @@ class FleetRouter:
             "serve_requeued_total",
             help="in-flight requests requeued off a failed replica",
         )
+        # measured queue drain throughput: Retry-After on a 429 is
+        # derived from this (hysteresis gap / rate), not a constant
+        self.drain_rate = DrainRateEstimator()
+        # measured warm-up cost (engine build + lattice precompile wall
+        # time, sampled per warm-up): the autoscaler's scale-up cost
+        # model and the capacity artifact both read this histogram
+        self._warmup_hist = self.registry.histogram(
+            "serve_replica_warmup_seconds",
+            help="wall seconds from scale-up to READY (engine build + "
+                 "lattice precompile; cheap when the persistent compile "
+                 "cache is warm)",
+        )
         self.scale_to(replicas if replicas is not None else fleet.replicas)
         # the supervisor owns the hang watchdog and the breaker re-warm
         # schedule; it wakes on the cond (close notifies it) or every
@@ -290,6 +306,7 @@ class FleetRouter:
             if rep.state != COLD:   # shrunk away before warm-up began
                 return
             self._set_state(rep, WARMING)
+        t0 = time.monotonic()
         try:
             engine = self.engine_factory(self.registry)
             secs = engine.precompile()
@@ -298,6 +315,7 @@ class FleetRouter:
                 labels={"replica": str(rep.index)},
                 help="wall seconds the replica's lattice precompile took",
             ).set(secs)
+            self._warmup_hist.observe(time.monotonic() - t0)
         except BaseException as e:
             rep.error = e
             with self._cond:
@@ -359,6 +377,39 @@ class FleetRouter:
         with self._cond:
             return [r.engine for r in self._replicas if r.engine is not None]
 
+    # -- autoscaler signal surface (serving/autoscale.py reads these) -------
+
+    def pending_depth(self) -> int:
+        """Current EDF pending-heap occupancy."""
+        with self._cond:
+            return len(self._heap)
+
+    def live_replica_count(self) -> int:
+        """Replicas counted by ``scale_to`` (cold/warming/ready/failed)
+        — the autoscaler's notion of current capacity, warm-ups
+        included so one queue spike cannot trigger a scale-up per tick
+        while the first new replica is still compiling."""
+        with self._cond:
+            return sum(r.state in (COLD, WARMING, READY, FAILED)
+                       for r in self._replicas)
+
+    def occupancy(self) -> float:
+        """Instantaneous busy fraction of READY replicas (a replica is
+        busy while it holds an in-flight dispatch claim); 0.0 when none
+        are READY."""
+        with self._cond:
+            ready = [r for r in self._replicas if r.state == READY]
+            if not ready:
+                return 0.0
+            return sum(r.inflight is not None for r in ready) / len(ready)
+
+    def warmup_cost_s(self) -> Optional[float]:
+        """Measured warm-up cost (p50 of serve_replica_warmup_seconds);
+        None until the first warm-up completes."""
+        if self._warmup_hist.count == 0:
+            return None
+        return self._warmup_hist.percentile(0.50)
+
     # -- admission ----------------------------------------------------------
 
     def _admit(self, req: SynthesisRequest) -> str:
@@ -406,10 +457,18 @@ class FleetRouter:
             self._shedding = True
         if self._shedding:
             self._shed_ctr.inc()
+            # Retry-After = hysteresis gap / measured drain rate: the
+            # seconds until the heap is back under the low watermark
+            # (where admission resumes) at the current service rate;
+            # shed_retry_after_s is only the fallback before any
+            # dispatch has completed
             raise Overloaded(
                 f"fleet pending queue at {depth}/{cap} (high watermark "
                 f"{self.fleet.shed_high_watermark:g}): shedding load",
-                retry_after_s=self.fleet.shed_retry_after_s,
+                retry_after_s=self.drain_rate.retry_after(
+                    max(depth - self.fleet.shed_low_watermark * cap, 1.0),
+                    self.fleet.shed_retry_after_s,
+                ),
             )
 
     def submit(self, request: SynthesisRequest) -> Future:
@@ -521,6 +580,9 @@ class FleetRouter:
                 retries=p.retries,
             )
         budget = self.fleet.class_deadline_ms[p.klass]
+        # an expiry removes the entry from the heap for good — it drains
+        # the queue exactly as a dispatch does for Retry-After purposes
+        self.drain_rate.note(1)
         p.future.set_exception(DeadlineExceeded(
             f"request {p.request.id!r} exceeded its {p.klass!r} deadline "
             f"budget ({budget:g} ms) before dispatch",
@@ -618,6 +680,9 @@ class FleetRouter:
                 )
             return False
         now = time.monotonic()
+        # the batch left the queue for good (every future resolves below,
+        # result or DispatchError): it is drain the Retry-After sees
+        self.drain_rate.note(len(batch), now=now)
         try:
             self.registry.counter(
                 "serve_batch_occupancy_total",

@@ -986,6 +986,18 @@ class SynthesisServer:
             out["replicas"] = {
                 str(i): s for i, s in sorted(self.router.states().items())
             }
+        # present only when an Autoscaler is driving scale_to(): the
+        # policy's last target plus its decision tally by reason
+        if "serve_autoscale_target" in gauges:
+            decisions = {}
+            for key, count in counters.items():
+                if key.startswith("serve_autoscale_decisions_total{"):
+                    reason = key.split('reason="', 1)[1].split('"', 1)[0]
+                    decisions[reason] = int(count)
+            out["autoscale"] = {
+                "target": int(gauges["serve_autoscale_target"]),
+                "decisions": dict(sorted(decisions.items())),
+            }
         return out
 
     def capture_profile(self, seconds: float):

@@ -890,6 +890,73 @@ def test_jl015_negative_outside_serving():
 
 
 # ---------------------------------------------------------------------------
+# JL016 — bare time.sleep in serving loops
+# ---------------------------------------------------------------------------
+
+
+def test_jl016_positive_sleep_in_supervision_loop():
+    src = """
+        import threading
+        import time
+
+        def _supervise(self):
+            while not self._stop:
+                self._sweep()
+                time.sleep(0.25)
+    """
+    found = [
+        f for f in linter.lint_source(textwrap.dedent(src), _SERVING_PATH)
+        if f.rule == "JL016"
+    ]
+    assert len(found) == 1
+    assert found[0].detail == "time.sleep in loop"
+    assert "Event.wait" in found[0].message
+
+
+def test_jl016_positive_bare_sleep_import_in_for_loop():
+    assert "JL016" in _codes("""
+        from time import sleep
+
+        def drain(self, replicas):
+            for rep in replicas:
+                sleep(0.1)
+    """, path=_SERVING_PATH)
+
+
+def test_jl016_negative_stop_aware_waits_and_one_shot_sleep():
+    # the sanctioned idioms: Event.wait / Condition.wait as the loop
+    # timer, and a one-shot settle sleep outside any loop
+    assert "JL016" not in _codes("""
+        import threading
+        import time
+
+        def _loop(self):
+            while not self._stop.wait(self.interval_s):
+                self.step()
+
+        def _supervise(self):
+            while True:
+                with self._cond:
+                    self._cond.wait(timeout=0.25)
+
+        def close(self):
+            time.sleep(0.06)
+    """, path=_SERVING_PATH)
+
+
+def test_jl016_negative_outside_serving():
+    # bench loops and training backoffs may sleep; only serving-side
+    # loops carry the stop-aware contract
+    assert "JL016" not in _codes("""
+        import time
+
+        def poll(self):
+            while self.busy():
+                time.sleep(0.01)
+    """, path="speakingstyle_tpu/training/fake.py")
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -1014,7 +1081,10 @@ def test_every_rule_is_non_vacuous():
     # live in ops/ and obs/, outside the rule's scope on purpose).
     # JL015 is absent because the PR that added it also moved every
     # dispatch-loop staging allocation onto the BufferPool — the rule
-    # exists to keep it that way.
+    # exists to keep it that way. JL016 is absent because every serving
+    # loop already parks stop-aware (the fleet supervisor on its
+    # Condition, the autoscaler on its Event) — the remaining sleeps
+    # are one-shot (close settle, injected-fault stall), outside loops.
     for code in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
                  "JL007", "JL008"):
         assert code in fired, f"{code} never fires on the real tree"
@@ -1054,11 +1124,13 @@ def test_cli_check_exits_zero_on_repo():
               "    return jax.device_put(v, jax.devices()[0])\n"),
     ("JL015", "import numpy as np\n\ndef handle(reqs):\n    for r in reqs:\n"
               "        buf = np.zeros((8,), np.float32)\n"),
+    ("JL016", "import time\n\ndef _supervise(self):\n    while True:\n"
+              "        time.sleep(0.25)\n"),
 ])
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
     # JL004 is scoped to training/ paths; JL007 to speakingstyle_tpu/;
-    # JL011-JL013 and JL015 to speakingstyle_tpu/serving/
-    sub = ("serving" if code in ("JL011", "JL012", "JL013", "JL015")
+    # JL011-JL013, JL015 and JL016 to speakingstyle_tpu/serving/
+    sub = ("serving" if code in ("JL011", "JL012", "JL013", "JL015", "JL016")
            else "training")
     d = tmp_path / "speakingstyle_tpu" / sub
     d.mkdir(parents=True)

@@ -1,0 +1,463 @@
+"""Traffic model + closed-loop autoscaler (tier-1).
+
+Three layers, mirroring the new subsystem:
+  1. TrafficModel — seeded determinism (same seed, identical schedule),
+     rate-curve shape (diurnal floor/peak, 10x flash windows), priority
+     mix riding the router's existing SLO classes, zipf style skew —
+     all host-only, no clock, no jax;
+  2. Autoscaler policy — driven synchronously against a fake router
+     with an explicit clock: scale-up on queue pressure / occupancy /
+     shed-pressure, per-direction cooldowns, max_step at extreme
+     pressure, hard [min, max] bounds, scale-down only after a calm
+     window stretched by the MEASURED warm-up cost, decision
+     observability (gauge + reason counter + autoscale events);
+  3. closed-loop e2e — a flash crowd against a real FleetRouter with
+     fake engines grows the fleet without operator input, recovers, and
+     shrinks back, with ZERO lost requests and zero compiles.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from speakingstyle_tpu.configs.config import (
+    AutoscaleConfig,
+    Config,
+    FleetConfig,
+    ServeConfig,
+)
+from speakingstyle_tpu.obs import MetricsRegistry
+from speakingstyle_tpu.serving.autoscale import Autoscaler
+from speakingstyle_tpu.serving.batcher import Overloaded, ShutdownError
+from speakingstyle_tpu.serving.fleet import FleetRouter
+from speakingstyle_tpu.serving.traffic import TrafficEvent, TrafficModel
+
+# ---------------------------------------------------------------------------
+# traffic model (no jax, no clock)
+# ---------------------------------------------------------------------------
+
+
+def _model(**kw):
+    args = dict(seed=7, base_qps=50.0, duration_s=6.0,
+                flash_windows=[(2.0, 4.0)], flash_multiplier=10.0,
+                n_styles=32)
+    args.update(kw)
+    return TrafficModel(**args)
+
+
+def test_traffic_same_seed_identical_schedule():
+    a, b = _model().schedule(), _model().schedule()
+    assert a == b                       # bit-identical events
+    assert _model().schedule() == a     # and stable across calls
+    assert a and all(isinstance(e, TrafficEvent) for e in a)
+
+
+def test_traffic_different_seed_differs():
+    assert _model().schedule() != _model(seed=8).schedule()
+
+
+def test_traffic_rate_curve_shape():
+    m = _model(diurnal_floor=0.4)
+    # diurnal: trough at t=0, peak mid-period
+    assert m.diurnal_at(0.0) == pytest.approx(0.4)
+    assert m.diurnal_at(3.0) == pytest.approx(1.0)
+    # flash multiplies the diurnal rate inside the window only
+    assert m.rate_at(3.0) == pytest.approx(10.0 * m.base_qps)
+    assert m.rate_at(1.0) < m.base_qps
+    # empirical arrivals track the curve: the flash window holds most
+    # of the schedule despite covering a third of the duration
+    sched = m.schedule()
+    in_flash = sum(2.0 <= e.t < 4.0 for e in sched)
+    assert in_flash / len(sched) > 0.6
+    assert all(0.0 <= e.t < m.duration_s for e in sched)
+    assert all(sched[i].t <= sched[i + 1].t for i in range(len(sched) - 1))
+
+
+def test_traffic_mix_rides_existing_priority_classes():
+    sched = _model(duration_s=20.0, flash_windows=[]).schedule()
+    kinds = {e.kind for e in sched}
+    assert kinds == {"interactive", "batch", "long_form"}
+    # long-form rides the batch SLO class and pins the largest bucket
+    for e in sched:
+        assert e.priority in ("interactive", "batch")
+        if e.kind == "long_form":
+            assert e.priority == "batch" and e.length_frac == 1.0
+        else:
+            assert 0.0 < e.length_frac < 1.0
+    frac_interactive = sum(
+        e.kind == "interactive" for e in sched) / len(sched)
+    assert 0.45 < frac_interactive < 0.75  # ~0.6 by weight
+
+
+def test_traffic_zipf_styles_are_skewed_and_bounded():
+    sched = _model(duration_s=30.0, flash_windows=[], n_styles=16).schedule()
+    styles = [e.style for e in sched]
+    assert all(0 <= s < 16 for s in styles)
+    counts = np.bincount(styles, minlength=16)
+    # rank 0 is the hottest voice and the tail is still visited
+    assert counts[0] == counts.max()
+    assert counts[0] > 3 * counts[8:].mean()
+    assert (counts > 0).sum() >= 8
+
+
+def test_traffic_validation():
+    with pytest.raises(ValueError, match="base_qps"):
+        _model(base_qps=0)
+    with pytest.raises(ValueError, match="flash window"):
+        _model(flash_windows=[(5.0, 99.0)])
+    with pytest.raises(ValueError, match="flash_multiplier"):
+        _model(flash_multiplier=0.5)
+    with pytest.raises(ValueError, match="unknown traffic kinds"):
+        _model(mix={"interactive": 1.0, "cinematic": 1.0})
+    with pytest.raises(ValueError, match="zipf_s"):
+        _model(zipf_s=0.0)
+    assert "seed" in _model().describe()
+
+
+# ---------------------------------------------------------------------------
+# autoscaler policy (fake router, explicit clock)
+# ---------------------------------------------------------------------------
+
+
+class FakeRouter:
+    """Signal-surface stand-in: the policy's entire view of the fleet."""
+
+    def __init__(self, queue_depth=100, replicas=1):
+        self.fleet = SimpleNamespace(queue_depth=queue_depth)
+        self.registry = MetricsRegistry()
+        self.events = None
+        self.depth = 0
+        self.occ = 0.0
+        self.live = replicas
+        self.warmup = None
+        self.scale_calls = []
+        self.closed = False
+
+    def pending_depth(self):
+        return self.depth
+
+    def live_replica_count(self):
+        return self.live
+
+    def occupancy(self):
+        return self.occ
+
+    def warmup_cost_s(self):
+        return self.warmup
+
+    def scale_to(self, n):
+        if self.closed:
+            raise ShutdownError("router is closed")
+        self.scale_calls.append(n)
+        self.live = n
+
+
+class FakeEvents:
+    def __init__(self):
+        self.records = []
+
+    def emit(self, name, **fields):
+        self.records.append((name, fields))
+
+
+def _acfg(**kw):
+    args = dict(enabled=True, min_replicas=1, max_replicas=4,
+                interval_s=0.1, up_queue_fraction=0.5, up_occupancy=0.9,
+                up_pressure_rate=1.0, down_queue_fraction=0.05,
+                down_occupancy=0.5, down_stable_s=1.0, cooldown_up_s=2.0,
+                cooldown_down_s=3.0, max_step=2, assumed_warmup_s=10.0,
+                warmup_cost_factor=1.0)
+    args.update(kw)
+    return AutoscaleConfig(**args)
+
+
+def test_autoscale_config_validation():
+    with pytest.raises(ValueError, match="min_replicas"):
+        AutoscaleConfig(min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        AutoscaleConfig(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError, match="down_queue_fraction"):
+        AutoscaleConfig(up_queue_fraction=0.3, down_queue_fraction=0.4)
+    with pytest.raises(ValueError, match="down_occupancy"):
+        AutoscaleConfig(up_occupancy=0.8, down_occupancy=0.9)
+    with pytest.raises(ValueError, match="max_step"):
+        AutoscaleConfig(max_step=0)
+    # disabled by default: arming is an explicit config decision
+    assert ServeConfig().autoscale.enabled is False
+
+
+def test_autoscaler_scales_up_on_queue_pressure_with_cooldown():
+    router = FakeRouter(queue_depth=100)
+    events = FakeEvents()
+    scaler = Autoscaler(router, _acfg(), events=events, start=False)
+    router.depth = 50                      # at the up watermark
+    assert scaler.step(now=100.0) == "queue_depth"
+    assert router.scale_calls == [2]
+    # still under pressure but inside cooldown_up_s: hold
+    assert scaler.step(now=101.0) is None
+    assert router.scale_calls == [2]
+    # cooldown elapsed: grow again
+    assert scaler.step(now=102.5) == "queue_depth"
+    assert router.scale_calls == [2, 3]
+    # observability: gauge, reason counter, events with signal values
+    assert router.registry.value("serve_autoscale_target") == 3
+    assert router.registry.value("serve_autoscale_decisions_total",
+                                 {"reason": "queue_depth"}) == 2
+    names = [n for n, _ in events.records]
+    assert names == ["autoscale", "autoscale"]
+    rec = events.records[0][1]
+    assert rec["decision"] == "up" and rec["reason"] == "queue_depth"
+    assert rec["depth"] == 50 and rec["target"] == 2
+
+
+def test_autoscaler_max_step_at_extreme_pressure_and_max_bound():
+    router = FakeRouter(queue_depth=100)
+    scaler = Autoscaler(router, _acfg(max_step=2), start=False)
+    router.depth = 100                     # past twice the up watermark
+    assert scaler.step(now=100.0) == "queue_depth"
+    assert router.scale_calls == [3]       # 1 + max_step
+    assert scaler.step(now=103.0) == "queue_depth"
+    assert router.scale_calls == [3, 4]    # clamped to max_replicas
+    # saturated: pressure can never push past the bound
+    for i in range(5):
+        assert scaler.step(now=110.0 + 3.0 * i) is None
+    assert router.scale_calls == [3, 4]
+    assert max(router.scale_calls) <= 4
+
+
+def test_autoscaler_occupancy_needs_sustained_backlog():
+    router = FakeRouter(queue_depth=100, replicas=2)
+    scaler = Autoscaler(router, _acfg(interval_s=0.5), start=False)
+    router.occ = 1.0                       # fully busy ...
+    router.depth = 1                       # ... but barely any backlog
+    assert scaler.step(now=100.0) is None  # right-sized: hold
+    router.depth = 2                       # one pending per live replica
+    assert scaler.step(now=101.0) is None  # first hot sample: not yet
+    assert scaler.step(now=101.6) == "occupancy"  # held a full tick
+    assert router.scale_calls == [3]
+    # a cool sample between two hot ones resets the persistence window:
+    # one mid-dispatch snapshot must not buy a replica
+    router.depth = 0
+    assert scaler.step(now=104.0) is None
+    router.depth = 3
+    assert scaler.step(now=104.5) is None  # hot again, streak restarted
+    assert scaler.step(now=105.1) == "occupancy"
+    assert router.scale_calls == [3, 4]
+    # on a ONE-replica fleet a single queued request is batch-formation
+    # latency, not pressure: the backlog gate floors at 2
+    solo = FakeRouter(queue_depth=100, replicas=1)
+    lone = Autoscaler(solo, _acfg(interval_s=0.5), start=False)
+    solo.occ = 1.0
+    solo.depth = 1
+    for i in range(4):
+        assert lone.step(now=200.0 + 0.6 * i) is None
+    assert solo.scale_calls == []
+
+
+def test_autoscaler_pressure_rate_trigger():
+    router = FakeRouter(queue_depth=100)
+    scaler = Autoscaler(router, _acfg(up_pressure_rate=5.0), start=False)
+    assert scaler.step(now=100.0) is None
+    shed = router.registry.counter("serve_shed_total")
+    router.registry.counter("serve_deadline_miss_total",
+                            labels={"class": "interactive"}).inc(2)
+    shed.inc(2)                            # 4 events over 1 s: under rate
+    assert scaler.step(now=101.0) is None
+    shed.inc(6)                            # 6 events over 1 s: over rate
+    assert scaler.step(now=102.0) == "pressure"
+    assert router.scale_calls == [2]
+
+
+def test_autoscaler_scale_down_waits_for_measured_warmup_window():
+    router = FakeRouter(queue_depth=100, replicas=3)
+    scaler = Autoscaler(
+        router,
+        _acfg(down_stable_s=1.0, cooldown_down_s=1.0, warmup_cost_factor=2.0),
+        start=False,
+    )
+    router.warmup = 4.0    # measured p50: calm window = max(1, 2*4) = 8 s
+    assert scaler.step(now=100.0) is None  # calm starts
+    assert scaler.step(now=104.0) is None  # 4 s calm < 8 s required
+    assert scaler.step(now=108.5) == "calm"
+    assert router.scale_calls == [2]
+    # the streak restarts after a shed: another full window before -1
+    assert scaler.step(now=109.0) is None
+    assert scaler.step(now=117.0) == "calm"
+    assert router.scale_calls == [2, 1]
+    # at min_replicas: calm never drains below the floor
+    for i in range(4):
+        assert scaler.step(now=120.0 + 9.0 * i) is None
+    assert router.live == 1
+    # unmeasured cost model: assumed_warmup_s stands in
+    router.warmup = None
+    assert scaler.warmup_cost_s() == 10.0
+
+
+def test_autoscaler_pressure_resets_calm_streak():
+    router = FakeRouter(queue_depth=100, replicas=2)
+    scaler = Autoscaler(router, _acfg(down_stable_s=1.0, cooldown_down_s=0.0,
+                                      warmup_cost_factor=0.0), start=False)
+    assert scaler.step(now=100.0) is None      # calm begins
+    router.depth = 60
+    # pressure interrupts the calm streak: the fleet grows instead
+    assert scaler.step(now=100.5) == "queue_depth"
+    assert router.scale_calls == [3]
+    router.depth = 0
+    assert scaler.step(now=101.0) is None      # calm restarts here
+    assert scaler.step(now=101.8) is None      # 0.8 s < down_stable_s
+    assert scaler.step(now=102.1) == "calm"
+    assert router.scale_calls == [3, 2]
+
+
+def test_autoscaler_bound_enforcement_and_closed_router():
+    router = FakeRouter(queue_depth=100, replicas=0)
+    scaler = Autoscaler(router, _acfg(min_replicas=2), start=False)
+    assert scaler.step(now=100.0) == "min_bound"
+    assert router.scale_calls == [2]
+    router.live = 9
+    assert scaler.step(now=100.1) == "max_bound"
+    assert router.scale_calls == [2, 4]
+    router.closed = True
+    router.live = 0
+    assert scaler.step(now=100.2) is None      # ShutdownError swallowed
+    scaler.close()
+
+
+def test_autoscaler_thread_is_stop_aware():
+    router = FakeRouter(queue_depth=100)
+    scaler = Autoscaler(router, _acfg(interval_s=30.0), start=True)
+    t0 = time.monotonic()
+    scaler.close()                         # must not wait out the tick
+    assert time.monotonic() - t0 < 5.0
+    assert scaler._thread is None
+
+
+# ---------------------------------------------------------------------------
+# closed-loop e2e: flash crowd -> grow -> recover -> shrink (fake engines)
+# ---------------------------------------------------------------------------
+
+
+class SlowEngine:
+    """Replica stand-in with a real service time, so capacity is finite
+    and a flash crowd actually queues."""
+
+    def __init__(self, service_s=0.02):
+        self.service_s = service_s
+
+    def precompile(self):
+        return 0.0
+
+    def run(self, requests):
+        time.sleep(self.service_s)
+        return [SimpleNamespace(id=r.id, bucket=None, mel_len=1)
+                for r in requests]
+
+
+def _req(i, **kw):
+    from speakingstyle_tpu.serving.engine import SynthesisRequest
+
+    return SynthesisRequest(
+        id=f"t{i}", sequence=np.ones(8, np.int32),
+        ref_mel=np.zeros((4, 80), np.float32), **kw,
+    )
+
+
+def test_autoscaler_closed_loop_flash_crowd():
+    from speakingstyle_tpu.serving.engine import CompileMonitor
+
+    cfg = Config(serve=ServeConfig(
+        batch_buckets=[1], src_buckets=[16], mel_buckets=[64],
+        frames_per_phoneme=2, max_wait_ms=1.0,
+        fleet=FleetConfig(
+            queue_depth=16, stream_window=8,
+            class_deadline_ms={"interactive": 60_000.0,
+                               "batch": 120_000.0},
+        ),
+        autoscale=AutoscaleConfig(
+            enabled=True, min_replicas=1, max_replicas=3,
+            interval_s=0.02, up_queue_fraction=0.25, up_occupancy=0.95,
+            up_pressure_rate=1e9,      # queue/occupancy drive this drill
+            down_queue_fraction=0.1, down_occupancy=0.5,
+            down_stable_s=0.3, cooldown_up_s=0.15, cooldown_down_s=0.3,
+            max_step=2, assumed_warmup_s=0.05, warmup_cost_factor=1.0,
+        ),
+    ))
+    registry = MetricsRegistry()
+    router = FleetRouter(lambda reg: SlowEngine(), cfg, replicas=1,
+                         registry=registry)
+    assert router.wait_ready(timeout=10)
+    scaler = Autoscaler(router, cfg.serve.autoscale)
+    peak_seen = [1]
+    stop_watch = threading.Event()
+
+    def watch():  # bounds witness: live count sampled through the storm
+        while not stop_watch.wait(0.01):
+            peak_seen[0] = max(peak_seen[0], router.live_replica_count())
+
+    watcher = threading.Thread(target=watch, daemon=True)
+    watcher.start()
+
+    counts = {"ok": 0, "shed": 0, "lost": 0}
+    lock = threading.Lock()
+
+    def client(cid, stop_at):
+        i = 0
+        while time.monotonic() < stop_at:
+            prio = "interactive" if (cid + i) % 2 == 0 else "batch"
+            try:
+                router.submit(_req(cid * 100_000 + i, priority=prio)) \
+                    .result(timeout=60)
+                k = "ok"
+            except Overloaded:
+                k = "shed"
+                time.sleep(0.002)
+            except Exception:
+                k = "lost"
+            with lock:
+                counts[k] += 1
+            i += 1
+
+    with CompileMonitor() as mon:
+        # flash crowd: 12 closed-loop clients against 1 replica of ~50
+        # req/s — the queue builds and the policy must grow the fleet
+        stop_at = time.monotonic() + 2.0
+        threads = [threading.Thread(target=client, args=(c, stop_at),
+                                    daemon=True) for c in range(12)]
+        for t in threads:
+            t.start()
+        grew = False
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if router.live_replica_count() > 1:
+                grew = True
+                break
+            time.sleep(0.01)
+        for t in threads:
+            t.join()
+        assert grew, "flash crowd never triggered a scale-up"
+        # recovery: load gone — the fleet must shrink back to the floor
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if router.live_replica_count() == 1:
+                break
+            time.sleep(0.02)
+        shrank = router.live_replica_count() == 1
+    stop_watch.set()
+    watcher.join(timeout=5)
+    scaler.close()
+    router.close()
+    assert shrank, "fleet never shrank back after the storm drained"
+    assert counts["ok"] > 0
+    assert counts["lost"] == 0, f"lost requests in the storm: {counts}"
+    assert peak_seen[0] <= 3, "autoscaler exceeded max_replicas"
+    assert mon.count == 0    # the policy layer must never compile
+    assert registry.value("serve_autoscale_target") == 1
+    snap = registry.snapshot()["counters"]
+    ups = sum(v for k, v in snap.items()
+              if k.startswith("serve_autoscale_decisions_total")
+              and 'reason="calm"' not in k)
+    downs = snap.get('serve_autoscale_decisions_total{reason="calm"}', 0)
+    assert ups >= 1 and downs >= 1
