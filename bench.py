@@ -1662,6 +1662,293 @@ def run_chaos(duration: float = 3.0, clients: int = 16,
     return point
 
 
+def run_rollout(duration: float = 3.0, clients: int = 16,
+                device_ms: float = 20.0):
+    """Live-upgrade drill: a canary-gated rolling rollout under
+    closed-loop load, plus a poisoned variant that must abort.
+
+    The run_chaos CPU-proxy fleet (2 replicas) serves checkpoint step 1
+    while step 2 — genuinely different weights, saved through the real
+    manifest-writing CheckpointManager — rolls out mid-load:
+
+      A  steady load on v1 under a CompileMonitor (must be 0 compiles);
+      B  ``RolloutManager.rollout(2)`` concurrent with the same load:
+         verify (strict manifest restore) -> canary surge replica ->
+         golden-set parity gate -> drain-replace both old replicas;
+      C  steady load on v2 under a CompileMonitor (must be 0 again —
+         every replacement warmed through the AOT precompile);
+      D  quiesced poison drill: ``checkpoint_corrupt`` armed on the
+         verify manager's fault plan, rollout(1) must abort in the
+         verify phase with the fleet untouched and v2 still serving.
+
+    Closed-loop clients await every submission, so
+    ``rollout_lost_requests`` is exact and carries a hard zero gate in
+    run_compare — a model upgrade that drops requests is an outage, not
+    a regression percentage.
+    """
+    import dataclasses
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    from speakingstyle_tpu.configs.config import FleetConfig
+    from speakingstyle_tpu.faults import FaultPlan
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.models.hifigan import Generator
+    from speakingstyle_tpu.obs import MetricsRegistry
+    from speakingstyle_tpu.serving.batcher import Overloaded
+    from speakingstyle_tpu.serving.engine import (
+        CompileMonitor,
+        SynthesisEngine,
+        SynthesisRequest,
+    )
+    from speakingstyle_tpu.serving.fleet import READY, FleetRouter
+    from speakingstyle_tpu.serving.lifecycle import RolloutManager
+    from speakingstyle_tpu.serving.style import StyleService
+    from speakingstyle_tpu.training.checkpoint import CheckpointManager
+
+    on_tpu = _is_tpu(jax.devices()[0])
+    if on_tpu:
+        device_ms = 0.0
+    label = "tiny-cpu-proxydev" if device_ms > 0 else (
+        "flagship" if on_tpu else "tiny-cpu"
+    )
+    _mark("building rollout fleet parts")
+    cfg = _fleet_proxy_config()
+    cfg = dataclasses.replace(cfg, serve=dataclasses.replace(
+        cfg.serve, fleet=FleetConfig(
+            stream_window=8, queue_depth=256,
+            class_deadline_ms={"interactive": 30_000.0, "batch": 60_000.0},
+            rewarm_backoff_s=0.2, rewarm_backoff_max_s=5.0,
+        ),
+    ))
+    serve = cfg.serve
+    n_position = max(serve.mel_buckets[-1], serve.src_buckets[-1],
+                     cfg.model.max_seq_len) + 1
+    model = build_model(cfg, n_position=n_position)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    gen = Generator(
+        upsample_rates=(2, 2), upsample_kernel_sizes=(4, 4),
+        upsample_initial_channel=16, resblock_kernel_sizes=(3,),
+        resblock_dilation_sizes=((1,),),
+    )
+    n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+    gparams = gen.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8, n_mels), np.float32)
+    )["params"]
+    rng = np.random.default_rng(0)
+    max_len = min(serve.src_buckets[-1],
+                  serve.mel_buckets[-1] // serve.frames_per_phoneme)
+    hot_refs = [
+        rng.standard_normal(
+            (int(rng.integers(8, serve.style.ref_buckets[-1] + 1)), n_mels)
+        ).astype(np.float32)
+        for _ in range(8)
+    ]
+
+    def make_request(i: int, priority: str) -> SynthesisRequest:
+        L = int(rng.integers(max(4, max_len // 2), max_len + 1))
+        return SynthesisRequest(
+            id=f"roll{i}",
+            sequence=rng.integers(1, 300, L).astype(np.int32),
+            ref_mel=hot_refs[i % len(hot_refs)],
+            priority=priority,
+        )
+
+    registry = MetricsRegistry()
+    ckpt_plan = FaultPlan()  # the verify gate's plan (poison drill)
+    shared_style = StyleService(cfg, variables, registry=registry)
+
+    # two REAL checkpoints through the manifest-writing manager: step 1
+    # is the live version, step 2 the candidate (genuinely different
+    # weights, close enough to pass the parity gate)
+    _mark("writing rollout checkpoints (step 1 + 2)")
+    ckpt_dir = tempfile.mkdtemp(prefix="bench_rollout_ckpt_")
+    writer = CheckpointManager(ckpt_dir)
+    writer.save(1, variables, block=True)
+    v2_variables = jax.tree_util.tree_map(
+        lambda x: x * (1.0 + 1e-3) if np.issubdtype(
+            np.asarray(x).dtype, np.floating) else x,
+        variables,
+    )
+    writer.save(2, v2_variables, block=True)
+    writer.close()
+
+    def verify_and_build(step: int):
+        """The rollout's trust boundary: strict manifest-verified
+        restore (CheckpointCorruptError aborts the rollout), then an
+        engine factory closed over the restored weights."""
+        ckpt = CheckpointManager(ckpt_dir, fault_plan=ckpt_plan,
+                                 registry=registry)
+        try:
+            restored = ckpt.restore(variables, step=step, strict=True)
+            info = {"step": ckpt.last_restored_step,
+                    "weights_digest": ckpt.last_weights_digest}
+        finally:
+            ckpt.close()
+        version = f"{step}:{(info['weights_digest'] or 'unverified')[:12]}"
+
+        def factory(reg):
+            return ProxyDeviceEngine(
+                SynthesisEngine(
+                    cfg, restored, vocoder=(gen, gparams), model=model,
+                    registry=reg, style=shared_style,
+                ),
+                device_ms,
+            )
+
+        return factory, version, info
+
+    _mark("warming 2 rollout replicas on v1")
+    factory1, version1, info1 = verify_and_build(1)
+    router = FleetRouter(factory1, cfg, replicas=2, registry=registry,
+                         style=shared_style)
+    router.set_model_version(version1, info1["step"],
+                             info1["weights_digest"])
+    if not router.wait_ready(timeout=600, n=2):
+        print(json.dumps({
+            "metric": "serve_rollout", "replicas": 2,
+            "error": "replicas never became ready", "model": label,
+        }))
+        router.close()
+        return None
+    mgr = RolloutManager(router, verify_and_build, registry=registry)
+
+    def transfer_warmup(base: int):
+        for engine in router.engines():
+            for b in engine.lattice.batch_buckets:
+                engine.run([make_request(base + b * 100 + j, "batch")
+                            for j in range(b)])
+
+    transfer_warmup(10_000_000)
+
+    def load_phase(phase_s: float, seed: int):
+        """Closed-loop load; every submitted request is awaited."""
+        stop_at = time.perf_counter() + phase_s
+        per = [dict(ok=0, shed=0, lost=0, errors=[])
+               for _ in range(clients)]
+
+        def client(cid: int):
+            c, i = per[cid], 0
+            while time.perf_counter() < stop_at:
+                prio = "interactive" if (cid + i) % 2 == 0 else "batch"
+                req = make_request(seed + cid * 1_000_000 + i, prio)
+                try:
+                    router.submit(req).result(timeout=120)
+                    c["ok"] += 1
+                except Overloaded:
+                    c["shed"] += 1
+                    time.sleep(0.002)
+                except Exception as e:  # structured failure OR stuck: lost
+                    c["lost"] += 1
+                    c["errors"].append(type(e).__name__)
+                i += 1
+
+        threads = [threading.Thread(target=client, args=(c,), daemon=True)
+                   for c in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        out = {k: sum(c[k] for c in per) for k in ("ok", "shed", "lost")}
+        out["errors"] = sorted({e for c in per for e in c["errors"]})
+        out["qps"] = out["ok"] / dt
+        return out
+
+    _mark("rollout phase A: steady load on v1")
+    with CompileMonitor() as pre_mon:
+        pre = load_phase(duration, 0)
+
+    _mark("rollout phase B: live upgrade under load")
+    roll_result = {}
+
+    def do_roll():
+        try:
+            roll_result.update(mgr.rollout(2))
+        except Exception as e:  # surfaced in the JSON point, never lost
+            roll_result.update(status="error",
+                               reason=f"{type(e).__name__}: {e}")
+
+    roll_thread = threading.Thread(target=do_roll, daemon=True)
+    roll_thread.start()
+    during = load_phase(duration, 100_000_000)
+    roll_thread.join(timeout=600)
+    committed = roll_result.get("status") == "committed"
+    post = None
+    post_compiles = None
+    if committed:
+        transfer_warmup(20_000_000)  # the new engines' first host paths
+        _mark("rollout phase C: steady load on v2")
+        with CompileMonitor() as post_mon:
+            post = load_phase(duration, 200_000_000)
+        post_compiles = post_mon.count
+
+    # -- poisoned variant: the verify gate must refuse a corrupt
+    # checkpoint with the fleet untouched and the NEW version serving
+    _mark("rollout phase D: poisoned verify (checkpoint_corrupt armed)")
+    version_before_poison = router.model_version
+    states_before = dict(router.states())
+    ckpt_plan.arm("checkpoint_corrupt", 1)  # fresh manager: 1st verify
+    try:
+        poisoned = mgr.rollout(1)
+    except Exception as e:
+        poisoned = {"status": "error", "reason": f"{type(e).__name__}: {e}"}
+    abort_ok = (
+        poisoned.get("status") == "aborted"
+        and poisoned.get("phase") == "verify"
+        and router.model_version == version_before_poison
+        # fleet untouched: identical state map (the rolled-away old
+        # replicas legitimately linger as STOPPED entries) with the new
+        # version's replicas still READY
+        and dict(router.states()) == states_before
+        and any(s == READY for s in router.states().values())
+    )
+    router.close()
+
+    lost = pre["lost"] + during["lost"] + (post["lost"] if post else 0)
+    steady_compiles = pre_mon.count + (
+        post_compiles if post_compiles is not None else 0
+    )
+    point = {
+        "metric": "serve_rollout",
+        "replicas": 2,
+        "clients": clients,
+        "committed": committed,
+        "from_version": version1,
+        "to_version": router.model_version,
+        "rollout_duration_ms": roll_result.get("duration_ms"),
+        "rollout_canary_ms": roll_result.get("canary_ms"),
+        "rollout_steady_compiles": steady_compiles,
+        "rollout_lost_requests": lost,
+        "pre_qps": round(pre["qps"], 2),
+        "during_qps": round(during["qps"], 2),
+        "post_qps": round(post["qps"], 2) if post else None,
+        "shed": pre["shed"] + during["shed"] + (
+            post["shed"] if post else 0
+        ),
+        "errors": sorted(set(
+            pre["errors"] + during["errors"]
+            + (post["errors"] if post else [])
+        )),
+        "abort_ok": abort_ok,
+        "abort_status": poisoned.get("status"),
+        "abort_phase": poisoned.get("phase"),
+        "abort_reason": poisoned.get("reason"),
+        "rollouts_committed": int(registry.value(
+            "serve_rollouts_total", {"outcome": "committed"})),
+        "rollouts_aborted": int(registry.value(
+            "serve_rollouts_total", {"outcome": "aborted"})),
+        "proxy_device_ms": device_ms,
+        "model": label,
+    }
+    print(json.dumps(point))
+    return point
+
+
 def run_traffic(duration: float = 4.0, base_qps: float = 12.0,
                 device_ms: float = 40.0, chaos: bool = True, seed: int = 0):
     """Capacity-planning storm: a seeded production-shaped workload
@@ -2225,6 +2512,14 @@ def _absorb_record(rec, metrics):
                                               "lower")
         if isinstance(rec.get("shed"), (int, float)):
             metrics["chaos_shed"] = (float(rec["shed"]), "lower")
+    elif m == "serve_rollout":
+        # the live-upgrade drill; rollout_lost_requests carries the same
+        # hard zero gate as chaos/traffic in run_compare — an upgrade
+        # that drops requests is an outage, not a percentage
+        for k in ("rollout_duration_ms", "rollout_canary_ms",
+                  "rollout_steady_compiles", "rollout_lost_requests"):
+            if isinstance(rec.get(k), (int, float)):
+                metrics[k] = (float(rec[k]), "lower")
     elif m == "serve_traffic":
         # the capacity storm's SLO numbers; lost_requests carries the
         # same hard zero gate as the chaos drill in run_compare
@@ -2344,6 +2639,15 @@ def run_compare(old_path, new_path=None, threshold=REGRESSION_THRESHOLD,
               f"{os.path.basename(new_path)}; every admitted request "
               "must reach a terminal state through flash + chaos + "
               "scale-down", file=out)
+        return 1
+    # and for the live-upgrade drill: a model rollout is zero-downtime
+    # by contract — any request lost through the swap fails the diff
+    lost = new.get("rollout_lost_requests")
+    if lost is not None and lost[0] > 0:
+        print(f"FAIL: rollout drill lost {int(lost[0])} request(s) in "
+              f"{os.path.basename(new_path)}; the canary-gated roll "
+              "must drain-replace without dropping in-flight work",
+              file=out)
         return 1
     common = sorted(set(old) & set(new))
     if not common:
@@ -2467,6 +2771,11 @@ if __name__ == "__main__":
         run_style(duration=dur)
         run_chaos(duration=dur)
         run_traffic(duration=dur)
+        run_rollout(duration=dur)
+    elif "--rollout" in sys.argv:
+        dur = (float(sys.argv[sys.argv.index("--duration") + 1])
+               if "--duration" in sys.argv else 3.0)
+        run_rollout(duration=dur)
     elif "--traffic" in sys.argv:
         dur = (float(sys.argv[sys.argv.index("--duration") + 1])
                if "--duration" in sys.argv else 4.0)

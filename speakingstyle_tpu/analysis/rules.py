@@ -62,6 +62,13 @@ JL016  bare time.sleep() inside a loop under speakingstyle_tpu/serving/
        autoscaler) must park on a stop-aware Event.wait(timeout) or
        Condition.wait so close()/drain interrupts them immediately; a
        sleeping thread holds shutdown hostage for up to a full tick
+JL017  non-atomic persistent writes under training/ or serving/:
+       open(path, "w"/"wb") or np.save/np.savez aimed at a
+       checkpoint/artifact-shaped path (ckpt, checkpoint, manifest,
+       weights, baseline, snapshot, artifact) with no temp-file +
+       os.replace in the enclosing scope — a crash mid-write leaves a
+       torn file that reads as CORRUPT, not absent; durable artifacts
+       must appear atomically (write <name>.tmp, fsync, os.replace)
 """
 
 import ast
@@ -1817,6 +1824,117 @@ def rule_jl016(mod: ModuleInfo) -> Iterator[Finding]:
         )
 
 
+# ---------------------------------------------------------------------------
+# JL017 — non-atomic persistent writes to checkpoint/artifact paths
+# ---------------------------------------------------------------------------
+
+
+_PERSIST_SAVE_CALLS = {"np.save", "np.savez", "numpy.save", "numpy.savez"}
+# path spellings that mark a durable artifact worth crash-safety
+_ARTIFACT_MARKERS = (
+    "ckpt", "checkpoint", "manifest", "weights", "baseline", "snapshot",
+    "artifact",
+)
+# spellings that mark the temp half of a temp+replace pattern
+_TEMP_MARKERS = ("tmp", "temp", "part")
+_ATOMIC_RENAME_CALLS = {"os.replace", "os.rename"}
+
+
+def _path_spelling(node: ast.AST) -> str:
+    """Every lexical fragment of a path expression, lowercased: string
+    constants, variable names, attribute chains, f-string parts — enough
+    to recognize ``ckpt_path`` / ``f"{d}/manifest.json"`` shapes without
+    evaluating anything."""
+    parts: List[str] = []
+    for n in ast.walk(node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            parts.append(n.value)
+        elif isinstance(n, ast.Name):
+            parts.append(n.id)
+        elif isinstance(n, ast.Attribute):
+            parts.append(n.attr)
+    return " ".join(parts).lower()
+
+
+def _scope_has_atomic_rename(mod: "ModuleInfo", node: ast.AST) -> bool:
+    """True when the enclosing function (or the module body, for
+    top-level code) performs an ``os.replace``/``os.rename`` — the
+    signature of the temp-file + atomic-publish idiom."""
+    scope = mod.enclosing_function(node) or mod.tree
+    return any(
+        isinstance(n, ast.Call) and _dotted(n.func) in _ATOMIC_RENAME_CALLS
+        for n in ast.walk(scope)
+    )
+
+
+def rule_jl017(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL017: non-atomic persistent writes — ``open(path, "w"/"wb")`` or
+    ``np.save``/``np.savez`` on a checkpoint/artifact-shaped path with
+    no temp + ``os.replace`` in the enclosing scope, under
+    ``speakingstyle_tpu/training/`` or ``speakingstyle_tpu/serving/``.
+
+    A durable artifact (checkpoint manifest, weights export, committed
+    baseline, capacity snapshot) must appear ATOMICALLY: a process
+    killed mid-``write()`` otherwise leaves a torn file that the next
+    reader sees as corrupt — precisely the failure the checkpoint
+    integrity layer (training/checkpoint.py) exists to catch, and one
+    that rename-into-place eliminates for free on POSIX. Write to
+    ``<name>.tmp`` in the same directory, flush+fsync, then
+    ``os.replace``. Writes whose path spelling is already temp-marked
+    (``tmp``/``temp``/``part``) are the first half of that idiom and
+    exempt, as is any write in a scope that also calls
+    ``os.replace``/``os.rename``.
+    """
+    p = mod.path.replace("\\", "/")
+    if ("speakingstyle_tpu/training/" not in p
+            and "speakingstyle_tpu/serving/" not in p):
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func)
+        path_arg = None
+        if callee == "open" and node.args:
+            mode = ""
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant) \
+                    and isinstance(node.args[1].value, str):
+                mode = node.args[1].value
+            for kw in node.keywords:
+                if kw.arg == "mode" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    mode = kw.value.value
+            if "w" not in mode:
+                continue  # reads and appends are not publishes
+            path_arg = node.args[0]
+        elif callee in _PERSIST_SAVE_CALLS and node.args:
+            path_arg = node.args[0]
+        else:
+            continue
+        spelling = _path_spelling(path_arg)
+        if not any(m in spelling for m in _ARTIFACT_MARKERS):
+            continue
+        if any(m in spelling for m in _TEMP_MARKERS):
+            continue  # the temp half of temp+replace
+        if _scope_has_atomic_rename(mod, node):
+            continue
+        qual = mod.qualname(node)
+        yield Finding(
+            rule="JL017",
+            path=mod.path,
+            line=node.lineno,
+            context=qual,
+            detail=f"non-atomic {callee} to artifact path",
+            message=(
+                f"`{callee}` writes a checkpoint/artifact-shaped path "
+                f"in place ({qual}): a crash mid-write leaves a torn "
+                "file the next reader sees as CORRUPT. Publish "
+                "atomically — write `<name>.tmp`, flush+fsync, then "
+                "`os.replace` (training/checkpoint.py's manifest "
+                "writer is the reference idiom)."
+            ),
+        )
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -1834,4 +1952,5 @@ RULES = {
     "JL014": rule_jl014,
     "JL015": rule_jl015,
     "JL016": rule_jl016,
+    "JL017": rule_jl017,
 }

@@ -79,15 +79,24 @@ def build_parser(parser=None):
              'request "ref_audio" paths (unset = uploads via POST /styles '
              "only)",
     )
+    parser.add_argument(
+        "--enable_rollout", action="store_true",
+        help="enable POST /admin/rollout (canary-gated rolling model "
+             "upgrade; fleet mode only — overrides serve.rollout.enabled)",
+    )
     return parser
 
 
 def load_engine_parts(cfg, restore_step: int, vocoder_ckpt=None,
-                      griffin_lim=False):
+                      griffin_lim=False, strict=False, fault_plan=None,
+                      events=None, registry=None):
     """Restore the acoustic checkpoint + vocoder ONCE; returns the
-    (variables, vocoder, lattice, model) quadruple every replica engine
-    shares — fleet replicas differ only in their compiled programs, so
-    the host-side weights are loaded a single time."""
+    (variables, vocoder, lattice, model, info) quintuple every replica
+    engine shares — fleet replicas differ only in their compiled
+    programs, so the host-side weights are loaded a single time.
+    ``info`` pins the model identity ({step, weights_digest}) for the
+    /healthz model block and X-Model-Version. ``strict=True`` refuses
+    manifest-less checkpoints (the rollout verify gate)."""
     import jax
 
     from speakingstyle_tpu.models.factory import build_model, init_variables
@@ -102,16 +111,32 @@ def load_engine_parts(cfg, restore_step: int, vocoder_ckpt=None,
     model = build_model(cfg, n_position=n_position)
     variables = init_variables(model, cfg, jax.random.PRNGKey(cfg.train.seed))
     state = TrainState.create(variables, make_optimizer(cfg.train))
-    ckpt = CheckpointManager(cfg.train.path.ckpt_path)
-    state = ckpt.restore(
-        state,
-        step=restore_step if restore_step > 0 else None,
-        ignore_layers=cfg.train.ignore_layers,
+    ckpt = CheckpointManager(
+        cfg.train.path.ckpt_path, fault_plan=fault_plan, events=events,
+        registry=registry,
     )
-    ckpt.close()
+    try:
+        state = ckpt.restore(
+            state,
+            step=restore_step if restore_step > 0 else None,
+            ignore_layers=cfg.train.ignore_layers,
+            strict=strict,
+        )
+        info = {
+            "step": ckpt.last_restored_step,
+            "weights_digest": ckpt.last_weights_digest,
+        }
+    finally:
+        ckpt.close()
     vocoder = None if griffin_lim else get_vocoder(cfg, vocoder_ckpt)
     variables = {"params": state.params, "batch_stats": state.batch_stats}
-    return variables, vocoder, lattice, model
+    return variables, vocoder, lattice, model, info
+
+
+def model_version_string(info) -> str:
+    """``<step>:<digest prefix>`` — the X-Model-Version wire format."""
+    digest = info.get("weights_digest") or "unverified"
+    return f"{info.get('step')}:{digest[:12]}"
 
 
 def load_engine(cfg, restore_step: int, vocoder_ckpt=None, griffin_lim=False,
@@ -123,8 +148,9 @@ def load_engine(cfg, restore_step: int, vocoder_ckpt=None, griffin_lim=False,
     """
     from speakingstyle_tpu.serving.engine import SynthesisEngine
 
-    variables, vocoder, lattice, model = load_engine_parts(
-        cfg, restore_step, vocoder_ckpt=vocoder_ckpt, griffin_lim=griffin_lim
+    variables, vocoder, lattice, model, _ = load_engine_parts(
+        cfg, restore_step, vocoder_ckpt=vocoder_ckpt, griffin_lim=griffin_lim,
+        fault_plan=fault_plan,
     )
     return SynthesisEngine(
         cfg, variables, vocoder=vocoder, lattice=lattice, model=model,
@@ -189,12 +215,12 @@ def main(args):
         from speakingstyle_tpu.serving.fleet import FleetRouter
         from speakingstyle_tpu.serving.style import StyleService
 
-        variables, vocoder, lattice, model = load_engine_parts(
+        registry = MetricsRegistry()
+        variables, vocoder, lattice, model, info = load_engine_parts(
             cfg, args.restore_step,
             vocoder_ckpt=args.vocoder_ckpt, griffin_lim=args.griffin_lim,
+            fault_plan=fault_plan, events=events, registry=registry,
         )
-
-        registry = MetricsRegistry()
         # ONE style service across all replicas: one embedding cache,
         # one AOT encoder lattice (the first replica's warm-up compiles
         # it; the rest find it ready)
@@ -216,6 +242,10 @@ def main(args):
             registry=registry, events=events, style=style,
             fault_plan=fault_plan,
         )
+        router.set_model_version(
+            model_version_string(info), info.get("step"),
+            info.get("weights_digest"),
+        )
         print(
             f"warming {replicas} replicas x {len(router.lattice)} lattice "
             "points in the background (healthz: 503 until ready) ...",
@@ -231,17 +261,53 @@ def main(args):
                 f"{acfg.max_replicas}] replicas, tick {acfg.interval_s}s "
                 f"(serve_autoscale_target tracks decisions)", flush=True,
             )
+        lifecycle = None
+        if args.enable_rollout or cfg.serve.rollout.enabled:
+            from speakingstyle_tpu.serving.lifecycle import RolloutManager
+
+            def verify_and_build(step: int):
+                # the rollout verify gate: strict manifest-checked
+                # restore — corrupt/manifest-less candidates abort here,
+                # before any replica is touched
+                v2, voc2, lat2, mdl2, info2 = load_engine_parts(
+                    cfg, step, vocoder_ckpt=args.vocoder_ckpt,
+                    griffin_lim=args.griffin_lim, strict=True,
+                    fault_plan=fault_plan, events=events, registry=registry,
+                )
+
+                def factory2(reg):
+                    return SynthesisEngine(
+                        cfg, v2, vocoder=voc2, lattice=lat2, model=mdl2,
+                        registry=reg, style=style, fault_plan=fault_plan,
+                    )
+
+                return factory2, model_version_string(info2), info2
+
+            lifecycle = RolloutManager(router, verify_and_build,
+                                       autoscaler=autoscaler, events=events)
+            print("rollout enabled: POST /admin/rollout {\"step\": N}",
+                  flush=True)
         server = SynthesisServer(
             frontend=TextFrontend(cfg, default_ref),
             host=args.host,
             port=args.port,
             events=events,
             router=router,
+            lifecycle=lifecycle,
         )
     else:
-        engine = load_engine(
+        if args.enable_rollout:
+            print("warning: --enable_rollout needs fleet mode "
+                  "(--replicas > 1); ignoring", flush=True)
+        from speakingstyle_tpu.serving.engine import SynthesisEngine
+
+        variables, vocoder, lattice, model, info = load_engine_parts(
             cfg, args.restore_step,
             vocoder_ckpt=args.vocoder_ckpt, griffin_lim=args.griffin_lim,
+            fault_plan=fault_plan, events=events,
+        )
+        engine = SynthesisEngine(
+            cfg, variables, vocoder=vocoder, lattice=lattice, model=model,
             fault_plan=fault_plan,
         )
         has_style = engine.style is not None
@@ -263,6 +329,7 @@ def main(args):
             host=args.host,
             port=args.port,
             events=events,
+            model_info=dict(info, version=model_version_string(info)),
         )
 
     # SIGTERM contract: stop accepting, drain in-flight streams (up to

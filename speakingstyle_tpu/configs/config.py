@@ -822,6 +822,51 @@ class StyleConfig:
 
 
 @dataclass(frozen=True)
+class RolloutConfig:
+    """Canary-gated rolling model rollout knobs (serving/lifecycle.py —
+    ARCHITECTURE.md "Model lifecycle").
+
+    A rollout verifies the new checkpoint's manifest, warms ONE canary
+    replica on the new weights, replays a seeded golden set through the
+    canary's AOT lattice (all-finite + mean-|Δmel| parity against the
+    live version), and only then drain-replaces the remaining replicas
+    one at a time. Any failure before commit aborts with the fleet
+    untouched.
+    """
+
+    # gate POST /admin/rollout (and the RolloutManager wiring) — OFF by
+    # default: a mutating admin surface must be opted into
+    enabled: bool = False
+    # golden-set size replayed through BOTH versions at the canary gate
+    golden_set_size: int = 4
+    # rng seed for the generated golden set (deterministic across runs)
+    canary_seed: int = 0
+    # mean |new_mel - old_mel| bound per golden request; generous by
+    # default — the gate is against BROKEN weights (NaN, wrong tree,
+    # garbage), not against intended retraining deltas
+    canary_tolerance: float = 1e3
+    # per-replica warm/drain wait during canary + roll phases
+    replica_timeout_s: float = 600.0
+
+    def __post_init__(self):
+        if self.golden_set_size <= 0:
+            raise ValueError(
+                "serve.rollout.golden_set_size must be > 0, "
+                f"got {self.golden_set_size}"
+            )
+        if self.canary_tolerance < 0:
+            raise ValueError(
+                "serve.rollout.canary_tolerance must be >= 0, "
+                f"got {self.canary_tolerance}"
+            )
+        if self.replica_timeout_s <= 0:
+            raise ValueError(
+                "serve.rollout.replica_timeout_s must be > 0, "
+                f"got {self.replica_timeout_s}"
+            )
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Continuous-batching synthesis server knobs (serving/engine.py,
     serving/batcher.py).
@@ -879,6 +924,8 @@ class ServeConfig:
     autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
     # style service: AOT reference-encoder lattice + embedding cache
     style: StyleConfig = field(default_factory=StyleConfig)
+    # canary-gated rolling model rollout (disabled by default)
+    rollout: RolloutConfig = field(default_factory=RolloutConfig)
 
     def __post_init__(self):
         for name in ("batch_buckets", "src_buckets", "mel_buckets"):

@@ -39,6 +39,16 @@ Counter semantics per kind:
                             (per engine instance) raises — a streaming
                             continuation fault
 
+  checkpoint (training/checkpoint.py; the lifecycle drills):
+
+  ``checkpoint_corrupt@N``  the CheckpointManager's Nth restore
+                            verification (per manager instance, 1-based)
+                            reports the step corrupt — raises
+                            CheckpointCorruptError before materializing
+  ``manifest_missing@N``    same counter; the Nth verification behaves
+                            as if the step's manifest.json were absent
+                            (legacy-tolerant unless restoring strictly)
+
 The plan is plain Python state constructed per run (``FaultPlan.from_env``)
 and threaded explicitly into the sites — no module globals, so tests can
 run many faulted loops in one process.  ``fire`` is thread-safe (serving
@@ -58,7 +68,8 @@ TRAINING_KINDS = ("loader_ioerror", "nan_grads", "sigterm")
 SERVING_KINDS = (
     "replica_raise", "replica_hang", "style_encode_error", "vocoder_raise",
 )
-KINDS = TRAINING_KINDS + SERVING_KINDS
+CHECKPOINT_KINDS = ("checkpoint_corrupt", "manifest_missing")
+KINDS = TRAINING_KINDS + SERVING_KINDS + CHECKPOINT_KINDS
 
 
 @dataclasses.dataclass

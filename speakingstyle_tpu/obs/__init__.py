@@ -21,7 +21,12 @@ The single instrumented spine shared by training, data, and serving
 Zero dependencies, no jax import at module scope.
 """
 
-from speakingstyle_tpu.obs.buildinfo import build_info, process_rss_bytes
+from speakingstyle_tpu.obs.buildinfo import (
+    array_sha256,
+    build_info,
+    process_rss_bytes,
+    weights_digest,
+)
 from speakingstyle_tpu.obs.cost import (
     FLOPS_PER_SEC_BUCKETS,
     ProgramCard,
@@ -56,6 +61,7 @@ __all__ = [
     "MetricsRegistry",
     "ProgramCard",
     "Span",
+    "array_sha256",
     "build_info",
     "device_memory_watermark",
     "device_memory_watermarks",
@@ -66,4 +72,5 @@ __all__ = [
     "read_events",
     "span",
     "watch_compiles",
+    "weights_digest",
 ]

@@ -14,10 +14,18 @@ both carry it.
 — the two gauges that turn a scrape into "which process, how long up,
 how big".
 
+``weights_digest()`` extends the identity from *code* to *model*: a
+single sha256 over a pytree of weights (order-independent: sorted
+per-leaf hashes), so ``/healthz``, ``train_start`` and the serving
+``X-Model-Version`` header can pin WHICH weights a process is running —
+the complement of the per-leaf manifest ``training/checkpoint.py``
+verifies at restore time.
+
 Everything degrades to ``None``/absent rather than raising: no git, no
 jax, no /proc must not take down a health endpoint.
 """
 
+import hashlib
 import os
 import platform
 import subprocess
@@ -59,6 +67,50 @@ def build_info() -> Dict:
     except Exception as e:
         info["jax_error"] = f"{type(e).__name__}: {e}"
     return info
+
+
+def array_sha256(arr) -> str:
+    """sha256 of one array's dtype + shape + raw bytes (host-side; the
+    caller device_gets first). Dtype and shape are hashed so a reshape
+    or cast never collides with the original."""
+    import numpy as np
+
+    a = np.ascontiguousarray(np.asarray(arr))
+    h = hashlib.sha256()
+    h.update(str(a.dtype).encode())
+    h.update(str(a.shape).encode())
+    h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def weights_digest(tree) -> Optional[str]:
+    """One order-independent sha256 over a whole weight pytree, or None
+    when it cannot be computed (no jax, abstract leaves, empty tree).
+    Feeding sorted ``name=leaf_sha`` lines into a single hash makes the
+    digest stable across flattening order and mesh layout — the same
+    weights give the same digest on 8x1 DP and 1x1 single-chip."""
+    try:
+        import jax
+
+        leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+        if not leaves:
+            return None
+        lines = []
+        for path, leaf in leaves:
+            name = "/".join(
+                str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+            )
+            lines.append(f"{name}={array_sha256(leaf)}\n")
+        h = hashlib.sha256()
+        for line in sorted(lines):
+            h.update(line.encode())
+        return h.hexdigest()
+    except Exception as e:
+        # identity must degrade, never raise (abstract leaves, no jax on
+        # a login node): absent-with-a-trace beats a dead health endpoint
+        print(f"[buildinfo] weights_digest unavailable: "
+              f"{type(e).__name__}: {e}")
+        return None
 
 
 def process_rss_bytes() -> Optional[float]:

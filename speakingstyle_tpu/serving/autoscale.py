@@ -123,6 +123,12 @@ class Autoscaler:
         self._last_tick = now
         self._last_pressure = pressure
 
+        # a live rollout holds every scale-DOWN: the canary surge would
+        # read as "over max_replicas" and a calm window must not drain
+        # the replica about to become the fleet (serving/lifecycle.py);
+        # scale-ups stay allowed — an upgrade under pressure still grows
+        rolling = bool(getattr(self.router, "rollout_active", False))
+
         # bound enforcement outranks hysteresis: an out-of-bounds fleet
         # (operator scale_to, config change) is corrected immediately
         if live < a.min_replicas:
@@ -130,6 +136,8 @@ class Autoscaler:
                                 depth=depth, live=live, occupancy=occ,
                                 pressure_rate=rate)
         if live > a.max_replicas:
+            if rolling:
+                return None
             return self._decide("down", "max_bound", a.max_replicas, now,
                                 depth=depth, live=live, occupancy=occ,
                                 pressure_rate=rate)
@@ -184,6 +192,9 @@ class Autoscaler:
             return None
         if self._last_scale is not None \
                 and now - self._last_scale < a.cooldown_down_s:
+            return None
+        if rolling:
+            self._calm_since = None  # the calm streak restarts post-roll
             return None
         return self._decide("down", "calm", live - 1, now, depth=depth,
                             live=live, occupancy=occ, pressure_rate=rate,

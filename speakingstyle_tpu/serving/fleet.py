@@ -127,6 +127,12 @@ class Replica:
         self.dispatch_started: Optional[float] = None
         self.dispatch_n = 0
         self.generation = 0
+        # model-lifecycle pin (serving/lifecycle.py): a replica started
+        # with an explicit factory re-warms with THAT factory forever —
+        # a mid-rollout breaker re-warm of an old replica must rebuild
+        # the OLD weights, never silently pick up the candidate's
+        self.factory: Optional[Callable] = None
+        self.version: Optional[str] = None
 
 
 class FleetRouter:
@@ -182,6 +188,13 @@ class FleetRouter:
         self._dispatch_total = 0  # router-global, under self._cond; the
         # counter the replica_raise@N / replica_hang@N fault kinds index
         self._watchdog = fleet.hang_watchdog_s
+        # model-lifecycle surface (serving/lifecycle.py): the running
+        # version string + a scale-down hold the autoscaler honors while
+        # a rollout's canary surge is live
+        self.rollout_active = False
+        self.model_version: Optional[str] = None
+        self.model_step: Optional[int] = None
+        self.model_digest: Optional[str] = None
 
         self._shed_ctr = self.registry.counter(
             "serve_shed_total",
@@ -308,7 +321,9 @@ class FleetRouter:
             self._set_state(rep, WARMING)
         t0 = time.monotonic()
         try:
-            engine = self.engine_factory(self.registry)
+            factory = rep.factory if rep.factory is not None \
+                else self.engine_factory
+            engine = factory(self.registry)
             secs = engine.precompile()
             self.registry.gauge(
                 "serve_replica_precompile_seconds",
@@ -376,6 +391,79 @@ class FleetRouter:
     def engines(self) -> List[SynthesisEngine]:
         with self._cond:
             return [r.engine for r in self._replicas if r.engine is not None]
+
+    def engine_at(self, index: int) -> Optional[SynthesisEngine]:
+        with self._cond:
+            return self._replicas[index].engine
+
+    # -- model lifecycle surface (serving/lifecycle.py drives these) ---------
+
+    def start_replica(self, factory: Optional[Callable] = None,
+                      version: Optional[str] = None) -> int:
+        """Append ONE replica — optionally pinned to its own engine
+        factory (the rollout canary builds candidate weights while
+        ``self.engine_factory`` still builds the live version) — and
+        warm it through the normal COLD->WARMING->READY lifecycle.
+        Returns the new replica's index."""
+        with self._cond:
+            if self._closing:
+                raise ShutdownError("router is closed")
+            rep = Replica(len(self._replicas), CircuitBreaker(
+                self.fleet.rewarm_backoff_s,
+                self.fleet.rewarm_backoff_max_s,
+            ))
+            rep.factory = factory
+            rep.version = version
+            self._replicas.append(rep)
+            self._set_state(rep, COLD)
+            self._set_breaker_gauge(rep)
+        threading.Thread(
+            target=self._warm, args=(rep,),
+            name=f"replica-{rep.index}-warmup", daemon=True,
+        ).start()
+        return rep.index
+
+    def drain_replica(self, index: int) -> None:
+        """Gracefully retire ONE specific replica (the rolling replace
+        picks old-version replicas by index; ``scale_to`` only ever
+        shrinks newest-first). READY drains — it finishes its in-flight
+        dispatch and stops pulling work; cold/warming/failed stop
+        immediately; draining/stopped is a no-op."""
+        with self._cond:
+            rep = self._replicas[index]
+            if rep.state == READY:
+                self._set_state(rep, DRAINING)
+            elif rep.state in (COLD, WARMING, FAILED):
+                self._set_state(rep, STOPPED)
+
+    def wait_state(self, index: int, states, timeout: float = 120.0) -> bool:
+        """Block until replica ``index`` reaches one of ``states``."""
+        want = (states,) if isinstance(states, str) else tuple(states)
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._replicas[index].state not in want:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+            return True
+
+    def set_model_version(self, version: Optional[str],
+                          step: Optional[int] = None,
+                          digest: Optional[str] = None) -> None:
+        """Publish the running model's identity: the
+        ``serve_model_version`` gauge (numeric: checkpoint step), the
+        ``X-Model-Version`` response header, and the /healthz model
+        block all read this."""
+        self.model_version = version
+        self.model_step = step
+        self.model_digest = digest
+        if step is not None:
+            self.registry.gauge(
+                "serve_model_version",
+                help="checkpoint step of the model version the fleet is "
+                     "serving (see the /healthz model block for the digest)",
+            ).set(step)
 
     # -- autoscaler signal surface (serving/autoscale.py reads these) -------
 

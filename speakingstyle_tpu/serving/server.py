@@ -428,11 +428,16 @@ class SynthesisServer:
         events: Optional[JsonlEventLog] = None,
         profile_dir: Optional[str] = None,
         router=None,
+        lifecycle=None,  # RolloutManager: gates POST /admin/rollout
+        model_info: Optional[Dict] = None,  # single-engine identity
+        # (fleet mode reads the router's set_model_version state instead)
     ):
         if engine is None and router is None:
             raise ValueError("SynthesisServer needs an engine or a router")
         self.engine = engine
         self.router = router
+        self.lifecycle = lifecycle
+        self._model_info = model_info
         self.cfg: Config = router.cfg if router is not None else engine.cfg
         serve = self.cfg.serve
         self.frontend = frontend
@@ -576,6 +581,8 @@ class SynthesisServer:
                 parsed = urlparse(self.path)
                 if parsed.path == "/debug/profile":
                     return self._profile(parsed)
+                if parsed.path == "/admin/rollout":
+                    return self._rollout()
                 if parsed.path == "/styles":
                     return self._post_style(parsed)
                 if parsed.path == "/synthesize/stream":
@@ -583,6 +590,40 @@ class SynthesisServer:
                 if parsed.path == "/synthesize":
                     return self._synthesize(parsed, stream=False)
                 return self._json(404, {"error": f"no route {self.path}"})
+
+            def _rollout(self):
+                """POST /admin/rollout {"step": N}: verify checkpoint N,
+                canary one replica on it, and roll the fleet — the
+                RolloutManager owns the whole state machine; this
+                handler only validates the request and maps outcomes
+                (409 on a concurrent rollout; both committed and
+                aborted are 200s carrying the outcome dict)."""
+                from speakingstyle_tpu.serving.lifecycle import (
+                    RolloutInProgress,
+                )
+
+                if outer.lifecycle is None:
+                    return self._json(404, {
+                        "error": "rollout is not enabled on this server "
+                                 "(start with --enable_rollout and a fleet)"
+                    })
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                except ValueError:
+                    return self._json(400, {"error": "body must be JSON"})
+                step = payload.get("step") if isinstance(payload, dict) \
+                    else None
+                if not isinstance(step, int) or isinstance(step, bool):
+                    return self._json(400, {
+                        "error": 'rollout needs an integer "step" '
+                                 "(the checkpoint to roll to)"
+                    })
+                try:
+                    result = outer.lifecycle.rollout(step)
+                except RolloutInProgress as e:
+                    return self._json(409, {"error": str(e)})
+                return self._json(200, result)
 
             def _post_style(self, parsed):
                 """Register a reference style: raw wav bytes in the body
@@ -693,10 +734,12 @@ class SynthesisServer:
                                       req_id=req_id, headers=headers)
                 if stream:
                     return self._stream_response(result, req_id, parsed, t0)
-                degraded_hdr = (
-                    {"X-Style-Degraded": "1"} if result.style_degraded
-                    else None
-                )
+                extra_hdr = {}
+                if result.style_degraded:
+                    extra_hdr["X-Style-Degraded"] = "1"
+                version = outer.model_version()
+                if version is not None:
+                    extra_hdr["X-Model-Version"] = version
                 if result.wav is None:
                     # vocoder-less engine: return the mel as JSON
                     outer._request_done(req_id, parsed.path, 200, t0)
@@ -704,7 +747,7 @@ class SynthesisServer:
                         "id": result.id,
                         "mel_len": result.mel_len,
                         "mel": result.mel.tolist(),
-                    }, req_id=req_id, headers=degraded_hdr)
+                    }, req_id=req_id, headers=extra_hdr or None)
                 sr = outer.cfg.preprocess.preprocessing.audio.sampling_rate
                 body = wav_bytes(result.wav, sr)
                 outer._request_done(req_id, parsed.path, 200, t0)
@@ -715,6 +758,8 @@ class SynthesisServer:
                 self.send_header("X-Batch-Rows", str(result.batch_rows))
                 if result.style_degraded:
                     self.send_header("X-Style-Degraded", "1")
+                if version is not None:
+                    self.send_header("X-Model-Version", version)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -735,6 +780,9 @@ class SynthesisServer:
                 self.send_header("X-Batch-Rows", str(result.batch_rows))
                 if result.style_degraded:
                     self.send_header("X-Style-Degraded", "1")
+                version = outer.model_version()
+                if version is not None:
+                    self.send_header("X-Model-Version", version)
                 self.end_headers()
                 try:
                     with outer.stream_scope():
@@ -915,6 +963,22 @@ class SynthesisServer:
                 duration_s=dur,
             )
 
+    def model_info(self) -> Optional[Dict]:
+        """{version, step, weights_digest} for the serving model, or
+        None when no identity was ever published (tests constructing a
+        bare server)."""
+        if self.router is not None and self.router.model_version is not None:
+            return {
+                "version": self.router.model_version,
+                "step": self.router.model_step,
+                "weights_digest": self.router.model_digest,
+            }
+        return self._model_info
+
+    def model_version(self) -> Optional[str]:
+        info = self.model_info()
+        return info.get("version") if info else None
+
     def refresh_process_gauges(self) -> None:
         """Sample process RSS + uptime into the registry (called at
         scrape so /metrics always exports a current value)."""
@@ -986,6 +1050,13 @@ class SynthesisServer:
             out["replicas"] = {
                 str(i): s for i, s in sorted(self.router.states().items())
             }
+        # which WEIGHTS is this process serving: version string +
+        # checkpoint step + digest (fleet mode tracks rollouts live via
+        # router.set_model_version; single-engine mode is pinned at
+        # startup by cli/serve.py)
+        model = self.model_info()
+        if model:
+            out["model"] = model
         # present only when an Autoscaler is driving scale_to(): the
         # policy's last target plus its decision tally by reason
         if "serve_autoscale_target" in gauges:

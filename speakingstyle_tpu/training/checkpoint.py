@@ -16,7 +16,22 @@ Resilience extensions (ISSUE 2, config: ``train.resilience.*``):
     ``keep_best``) the best-val-loss step, pruned after each save.
   * **robust latest-step restore** — ``restore(step=None)`` walks steps
     newest-first and falls back past a partial/corrupt checkpoint
-    directory (crashed mid-write) instead of bricking the resume.
+    directory (crashed mid-write) instead of bricking the resume,
+    distinguishing corrupt (``ckpt_corrupt_skipped`` event + counter)
+    from merely absent.
+
+Integrity (ISSUE 13): every save writes ``<step>/manifest.json`` — the
+per-leaf sha256 table, tree structure, step, an optional config
+fingerprint, and the params-wide ``weights_digest`` — via a temp file +
+``os.replace`` so the manifest is atomic: it exists iff it is complete.
+Restore verifies the manifest BEFORE handing anything to the caller and
+raises ``CheckpointCorruptError`` (structured: ``.step``/``.reason``),
+which is a different failure than "no checkpoint here". Manifests are
+only advisory for pre-manifest checkpoints (``strict=False`` tolerates
+their absence); a rollout's verify gate restores with ``strict=True``.
+The ``checkpoint_corrupt@N`` / ``manifest_missing@N`` fault kinds
+(faults.py) drill both paths deterministically, counted per manager
+instance on the 1-based verification counter ``verify_count``.
 
 Sharding awareness / cross-mesh-shape resume (ISSUE 10): the on-disk
 format is mesh-agnostic — ``save()``'s device->host snapshot
@@ -29,6 +44,7 @@ directly into that layout: save on an 8x1 DP mesh, restore onto 4x2
 DP×TP or 1x1 single-chip, bit-identically (tests/test_multichip.py).
 """
 
+import json
 import os
 import re
 import threading
@@ -37,7 +53,26 @@ from typing import Dict, List, Optional, Sequence
 import jax
 import orbax.checkpoint as ocp
 
+from speakingstyle_tpu.obs.buildinfo import array_sha256, weights_digest
 from speakingstyle_tpu.training.state import TrainState
+
+MANIFEST_NAME = "manifest.json"
+MANIFEST_FORMAT = 1
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint EXISTS but failed integrity verification — distinct
+    from FileNotFoundError (absent). Carries the step and a machine-
+    readable reason (``manifest_missing``, ``manifest_malformed``,
+    ``leaf_set_mismatch``, ``leaf_hash_mismatch``, ``injected``)."""
+
+    def __init__(self, step: int, reason: str, detail: str = ""):
+        self.step = step
+        self.reason = reason
+        msg = f"checkpoint step {step} is corrupt ({reason})"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
 
 
 def _abstract_leaf(x):
@@ -47,6 +82,26 @@ def _abstract_leaf(x):
     return ocp.utils.to_shape_dtype_struct(x)
 
 
+def _leaf_table(tree) -> Dict[str, Dict]:
+    """{'/'-joined leaf path: {sha256, shape, dtype}} for a host tree.
+    The same naming as the manifest verifier and ``weights_digest`` use,
+    so one flattening convention covers save, verify, and identity."""
+    import numpy as np
+
+    table: Dict[str, Dict] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        name = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        )
+        a = np.asarray(leaf)
+        table[name] = {
+            "sha256": array_sha256(a),
+            "shape": list(a.shape),
+            "dtype": str(a.dtype),
+        }
+    return table
+
+
 class CheckpointManager:
     def __init__(
         self,
@@ -54,6 +109,11 @@ class CheckpointManager:
         max_to_keep: Optional[int] = None,
         async_save: bool = False,
         keep_best: bool = False,
+        fault_plan=None,
+        events=None,
+        registry=None,
+        config_fingerprint: Optional[str] = None,
+        verify: bool = True,
     ):
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
@@ -73,6 +133,14 @@ class CheckpointManager:
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
+        self.fault_plan = fault_plan
+        self.events = events
+        self.registry = registry
+        self.config_fingerprint = config_fingerprint
+        self.verify = verify
+        self.verify_count = 0  # 1-based fault-site counter (per instance)
+        self.last_restored_step: Optional[int] = None
+        self.last_weights_digest: Optional[str] = None
 
     # -- saving -------------------------------------------------------------
 
@@ -110,10 +178,34 @@ class CheckpointManager:
     def _write(self, step: int, host_state, val_loss):
         self.manager.save(step, args=ocp.args.StandardSave(host_state))
         self.manager.wait_until_finished()
+        self._write_manifest(step, host_state)
         with self._lock:
             if val_loss is not None:
                 self._metrics[step] = float(val_loss)
         self._prune()
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self.directory, str(step), MANIFEST_NAME)
+
+    def _write_manifest(self, step: int, host_state):
+        """The integrity record, atomic via temp + os.replace: a torn
+        write leaves no manifest at all (absent, never malformed)."""
+        manifest = {
+            "format": MANIFEST_FORMAT,
+            "step": int(step),
+            "config_fingerprint": self.config_fingerprint,
+            "weights_digest": weights_digest(
+                getattr(host_state, "params", host_state)
+            ),
+            "leaves": _leaf_table(host_state),
+        }
+        path = self._manifest_path(step)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(manifest, fh, indent=1, sort_keys=True)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
 
     def save_in_flight(self) -> bool:
         t = self._thread
@@ -158,18 +250,89 @@ class CheckpointManager:
     def all_steps(self) -> List[int]:
         return sorted(self.manager.all_steps())
 
-    def _restore_step(self, step: int, abstract):
+    def _load_manifest(self, step: int) -> Optional[Dict]:
+        """Parse the step's manifest, or None when absent. Malformed
+        JSON is CORRUPT, not absent: the atomic writer never leaves a
+        half manifest, so a torn file means the directory was damaged."""
+        path = self._manifest_path(step)
+        if not os.path.isfile(path):
+            return None
+        try:
+            with open(path, encoding="utf-8") as fh:
+                manifest = json.load(fh)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptError(
+                step, "manifest_malformed", f"{type(e).__name__}: {e}"
+            ) from e
+        if not isinstance(manifest, dict) or "leaves" not in manifest:
+            raise CheckpointCorruptError(
+                step, "manifest_malformed", "no leaf table"
+            )
+        return manifest
+
+    def _verify_restored(self, step: int, manifest: Dict, restored):
+        """Per-leaf hash comparison of the materialized tree against the
+        manifest written at save time."""
+        got = _leaf_table(jax.device_get(restored))
+        want = manifest["leaves"]
+        if set(got) != set(want):
+            missing = sorted(set(want) - set(got))[:3]
+            extra = sorted(set(got) - set(want))[:3]
+            raise CheckpointCorruptError(
+                step, "leaf_set_mismatch",
+                f"missing={missing} extra={extra}",
+            )
+        bad = [n for n in want if got[n]["sha256"] != want[n]["sha256"]]
+        if bad:
+            raise CheckpointCorruptError(
+                step, "leaf_hash_mismatch",
+                f"{len(bad)} leaves, first: {sorted(bad)[:3]}",
+            )
+
+    def _restore_step(self, step: int, abstract, strict: bool = False):
         """Restore one step via a standalone checkpointer aimed straight
         at the step's item directory. The CheckpointManager is NOT used
         here on purpose: a single failed ``manager.restore`` (a corrupt
         step directory) permanently flips its item-handler registry into
         multi-item mode, after which every later restore — including of
         healthy steps — fails. The standalone path is stateless, so the
-        newest-first fallback scan can keep probing."""
+        newest-first fallback scan can keep probing.
+
+        The manifest is checked BEFORE materializing (a malformed one
+        never costs a restore) and the per-leaf hashes after; either
+        failure raises CheckpointCorruptError. ``strict`` additionally
+        treats a missing manifest as corrupt (rollout verify gates);
+        the default tolerates pre-manifest checkpoints."""
         path = os.path.join(self.directory, str(step), "default")
         if not os.path.isdir(path):
             raise FileNotFoundError(f"no checkpoint item at {path}")
-        return ocp.StandardCheckpointer().restore(path, abstract)
+        self.verify_count += 1
+        n = self.verify_count
+        plan = self.fault_plan
+        if plan is not None and plan.fire("checkpoint_corrupt", n):
+            raise CheckpointCorruptError(step, "injected", "fault drill")
+        manifest = None
+        if self.verify:
+            if plan is not None and plan.fire("manifest_missing", n):
+                manifest = None  # drill: behave as if never written
+            else:
+                manifest = self._load_manifest(step)
+            if manifest is None and strict:
+                raise CheckpointCorruptError(
+                    step, "manifest_missing",
+                    "strict restore requires a save-time manifest",
+                )
+        restored = ocp.StandardCheckpointer().restore(path, abstract)
+        if manifest is not None:
+            self._verify_restored(step, manifest, restored)
+            self.last_weights_digest = manifest.get("weights_digest")
+        else:
+            # legacy checkpoint: identity computed, not verified
+            self.last_weights_digest = weights_digest(
+                getattr(restored, "params", restored)
+            )
+        self.last_restored_step = step
+        return restored
 
     def latest_step(self) -> Optional[int]:
         return self.manager.latest_step()
@@ -179,6 +342,7 @@ class CheckpointManager:
         state,
         step: Optional[int] = None,
         ignore_layers: Sequence[str] = (),
+        strict: bool = False,
     ) -> TrainState:
         """Restore into the shape — and SHARDINGS — of ``state`` (concrete
         arrays or a jax.ShapeDtypeStruct template, e.g.
@@ -188,8 +352,11 @@ class CheckpointManager:
 
         ``step=None`` restores the latest step, falling back past
         partial/corrupt checkpoint directories (newest-first) so one
-        crashed write cannot brick a resume. An explicitly requested
-        step fails loudly instead.
+        crashed write cannot brick a resume — each corrupt (not merely
+        absent) step skipped emits a ``ckpt_corrupt_skipped`` event and
+        bumps ``ckpt_corrupt_skipped_total``. An explicitly requested
+        step fails loudly instead. ``strict=True`` (rollout verify)
+        refuses manifest-less checkpoints.
 
         ignore_layers: regexes matched against '/'-joined param paths;
         matching leaves keep their freshly-initialized values AND the
@@ -207,12 +374,17 @@ class CheckpointManager:
         failures = []
         for s in candidates:
             try:
-                restored = self._restore_step(s, abstract)
+                restored = self._restore_step(s, abstract, strict=strict)
                 break
             except Exception as e:
                 if step is not None:
                     raise
                 failures.append((s, f"{type(e).__name__}: {e}"))
+                # corrupt-vs-absent triage: an absent item directory is a
+                # routine hole in the walk; anything else means the step
+                # EXISTS and is damaged — observable, never silent
+                if not isinstance(e, FileNotFoundError):
+                    self._note_corrupt_skip(s, e)
                 print(
                     f"[checkpoint] step {s} under {self.directory} is not "
                     f"restorable ({type(e).__name__}); trying the previous step"
@@ -234,6 +406,20 @@ class CheckpointManager:
             )
             return state.replace(params=params, batch_stats=restored.batch_stats)
         return restored
+
+    def _note_corrupt_skip(self, step: int, error: BaseException) -> None:
+        reason = getattr(error, "reason", type(error).__name__)
+        if self.registry is not None:
+            self.registry.counter(
+                "ckpt_corrupt_skipped_total",
+                help="corrupt (not absent) checkpoints skipped by the "
+                     "newest-first restore walk",
+            ).inc()
+        if self.events is not None:
+            self.events.emit(
+                "ckpt_corrupt_skipped", step=int(step), reason=str(reason),
+                error=f"{type(error).__name__}: {error}",
+            )
 
     def close(self):
         try:

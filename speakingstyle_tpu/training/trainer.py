@@ -372,6 +372,8 @@ def run_training(
         max_to_keep=res.max_to_keep or None,
         async_save=res.async_checkpointing,
         keep_best=res.keep_best,
+        fault_plan=plan,
+        registry=registry,
     )
 
     state_shardings = None
@@ -495,6 +497,8 @@ def run_training(
             mesh_shape=(dict(mesh.shape) if mesh is not None
                         else {"data": 1, "model": 1}),
             mesh_devices=n_mesh_devices,
+            checkpoint_step=ckpt.last_restored_step,
+            weights_digest=ckpt.last_weights_digest,
             **obs.build_info(),
         )
     if synth_callback == "default":

@@ -957,6 +957,83 @@ def test_jl016_negative_outside_serving():
 
 
 # ---------------------------------------------------------------------------
+# JL017 — non-atomic persistent writes to artifact paths
+# ---------------------------------------------------------------------------
+
+_TRAINING_PATH = "speakingstyle_tpu/training/fake.py"
+
+
+def test_jl017_positive_open_w_on_manifest_path():
+    found = [
+        f for f in linter.lint_source(textwrap.dedent("""
+            import json
+
+            def save_manifest(manifest_path, data):
+                with open(manifest_path, "w") as fh:
+                    json.dump(data, fh)
+        """), _TRAINING_PATH)
+        if f.rule == "JL017"
+    ]
+    assert len(found) == 1
+    assert "non-atomic open" in found[0].detail
+    assert "os.replace" in found[0].message
+
+
+def test_jl017_positive_np_save_on_weights_path():
+    assert "JL017" in _codes("""
+        import numpy as np
+
+        def snapshot(weights_path, arr):
+            np.save(weights_path, arr)
+    """, path=_SERVING_PATH)
+
+
+def test_jl017_positive_mode_keyword():
+    assert "JL017" in _codes("""
+        def write(ckpt_dir):
+            fh = open(ckpt_dir + "/state.json", mode="w")
+            fh.close()
+    """, path=_TRAINING_PATH)
+
+
+def test_jl017_negative_temp_then_replace():
+    # the sanctioned idiom: write a temp sibling, fsync, os.replace —
+    # either the temp marker in the path or the rename in scope clears it
+    assert "JL017" not in _codes("""
+        import json
+        import os
+
+        def save_manifest(manifest_path, data):
+            tmp = manifest_path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(data, fh)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, manifest_path)
+    """, path=_TRAINING_PATH)
+
+
+def test_jl017_negative_non_artifact_path_and_read_mode():
+    # log files and reads are out of scope; only artifact-shaped names
+    # (ckpt / manifest / weights / ...) carry the atomicity contract
+    assert "JL017" not in _codes("""
+        def dump(log_path, ckpt_path):
+            open(log_path, "w").close()
+            open(ckpt_path).read()
+    """, path=_SERVING_PATH)
+
+
+def test_jl017_negative_outside_training_serving():
+    # bench/analysis scratch writes are exempt: the rule polices the
+    # persistent-state subtrees only
+    assert "JL017" not in _codes("""
+        def save(ckpt_path, blob):
+            with open(ckpt_path, "w") as fh:
+                fh.write(blob)
+    """, path="speakingstyle_tpu/analysis/fake.py")
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -1085,6 +1162,9 @@ def test_every_rule_is_non_vacuous():
     # loop already parks stop-aware (the fleet supervisor on its
     # Condition, the autoscaler on its Event) — the remaining sleeps
     # are one-shot (close settle, injected-fault stall), outside loops.
+    # JL017 is absent because the one in-scope artifact writer (the
+    # checkpoint manifest in training/checkpoint.py) already publishes
+    # via temp + fsync + os.replace — the idiom the rule enforces.
     for code in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
                  "JL007", "JL008"):
         assert code in fired, f"{code} never fires on the real tree"
@@ -1126,10 +1206,14 @@ def test_cli_check_exits_zero_on_repo():
               "        buf = np.zeros((8,), np.float32)\n"),
     ("JL016", "import time\n\ndef _supervise(self):\n    while True:\n"
               "        time.sleep(0.25)\n"),
+    ("JL017", "def save(ckpt_path, blob):\n"
+              "    with open(ckpt_path, \"w\") as fh:\n"
+              "        fh.write(blob)\n"),
 ])
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
     # JL004 is scoped to training/ paths; JL007 to speakingstyle_tpu/;
-    # JL011-JL013, JL015 and JL016 to speakingstyle_tpu/serving/
+    # JL011-JL013, JL015 and JL016 to speakingstyle_tpu/serving/;
+    # JL017 to both training/ and serving/ (training default suffices)
     sub = ("serving" if code in ("JL011", "JL012", "JL013", "JL015", "JL016")
            else "training")
     d = tmp_path / "speakingstyle_tpu" / sub

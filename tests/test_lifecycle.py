@@ -1,0 +1,746 @@
+"""Model lifecycle drills: verified checkpoints + canary-gated rollout.
+
+Four layers, mirroring ARCHITECTURE.md "Model lifecycle":
+  1. checkpoint integrity — the save-time manifest (per-leaf sha256,
+     atomic via temp + os.replace) and the restore-time verification:
+     tamper detection, strict mode, the injected fault kinds, and the
+     newest-first walk distinguishing corrupt from absent;
+  2. the canary gate against fake engines (no jax in the fleet path):
+     a passing canary commits the new factory and publishes the
+     version, a non-finite or out-of-tolerance canary aborts with the
+     fleet untouched, and a failed verify never starts a replica;
+  3. the rolling replace: zero lost requests under closed-loop load,
+     READY never below the pre-roll fleet size, zero scale-down from
+     the autoscaler while ``rollout_active`` holds;
+  4. the HTTP surface — POST /admin/rollout validation, 409 on a
+     concurrent rollout, outcome dicts as 200s, and the /healthz model
+     block carrying the committed version.
+"""
+
+import json
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from speakingstyle_tpu.configs.config import (
+    AutoscaleConfig,
+    Config,
+    FleetConfig,
+    RolloutConfig,
+    ServeConfig,
+)
+from speakingstyle_tpu.faults import FaultPlan
+from speakingstyle_tpu.obs import MetricsRegistry, weights_digest
+from speakingstyle_tpu.serving.autoscale import Autoscaler
+from speakingstyle_tpu.serving.batcher import ShutdownError
+from speakingstyle_tpu.serving.engine import SynthesisRequest
+from speakingstyle_tpu.serving.fleet import READY, STOPPED, FleetRouter
+from speakingstyle_tpu.serving.lifecycle import (
+    RolloutInProgress,
+    RolloutManager,
+    make_golden_set,
+)
+from speakingstyle_tpu.training.checkpoint import (
+    MANIFEST_NAME,
+    CheckpointCorruptError,
+    CheckpointManager,
+)
+
+# ---------------------------------------------------------------------------
+# 1. checkpoint integrity (real manager, toy state)
+# ---------------------------------------------------------------------------
+
+
+def _toy_state(value: float):
+    return {
+        "step": jnp.asarray(int(value), jnp.int32),
+        "w": jnp.full((4,), value, jnp.float32),
+    }
+
+
+class _Events:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.records = []
+
+    def emit(self, event, **fields):
+        with self.lock:
+            self.records.append((event, fields))
+
+    def kinds(self):
+        with self.lock:
+            return [k for k, _ in self.records]
+
+    def of(self, kind):
+        with self.lock:
+            return [dict(f) for k, f in self.records if k == kind]
+
+
+def test_manifest_roundtrip_and_identity(tmp_path):
+    """Every save writes an atomic manifest; restore verifies it and
+    records the step + weights digest for /healthz and train_start."""
+    root = str(tmp_path / "ck")
+    ckpt = CheckpointManager(root, config_fingerprint="cfgfp")
+    ckpt.save(3, _toy_state(3.0), block=True)
+    path = os.path.join(root, "3", MANIFEST_NAME)
+    assert os.path.isfile(path)
+    assert not os.path.exists(path + ".tmp")  # temp never lingers
+    with open(path, encoding="utf-8") as fh:
+        manifest = json.load(fh)
+    assert manifest["step"] == 3
+    assert manifest["config_fingerprint"] == "cfgfp"
+    assert set(manifest["leaves"]) == {"step", "w"}
+    for leaf in manifest["leaves"].values():
+        assert len(leaf["sha256"]) == 64
+
+    restored = ckpt.restore(_toy_state(0.0), step=3, strict=True)
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.full(4, 3.0))
+    assert ckpt.last_restored_step == 3
+    assert ckpt.last_weights_digest == manifest["weights_digest"]
+    assert ckpt.verify_count == 1
+    ckpt.close()
+
+
+def test_weights_digest_detects_changed_weights():
+    a = {"w": np.ones((2, 3), np.float32), "b": np.zeros((3,), np.float32)}
+    b = {"w": np.ones((2, 3), np.float32), "b": np.zeros((3,), np.float32)}
+    c = {"w": np.ones((2, 3), np.float32),
+         "b": np.full((3,), 1e-6, np.float32)}
+    assert weights_digest(a) == weights_digest(b)
+    assert weights_digest(a) != weights_digest(c)
+
+
+def test_tampered_manifest_hash_raises_corrupt(tmp_path):
+    root = str(tmp_path / "ck")
+    ckpt = CheckpointManager(root)
+    ckpt.save(1, _toy_state(1.0), block=True)
+    path = os.path.join(root, "1", MANIFEST_NAME)
+    with open(path, encoding="utf-8") as fh:
+        manifest = json.load(fh)
+    manifest["leaves"]["w"]["sha256"] = "0" * 64
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(manifest, fh)
+    with pytest.raises(CheckpointCorruptError) as ei:
+        ckpt.restore(_toy_state(0.0), step=1)
+    assert ei.value.reason == "leaf_hash_mismatch" and ei.value.step == 1
+    ckpt.close()
+
+
+def test_malformed_manifest_is_corrupt_not_absent(tmp_path):
+    root = str(tmp_path / "ck")
+    ckpt = CheckpointManager(root)
+    ckpt.save(1, _toy_state(1.0), block=True)
+    with open(os.path.join(root, "1", MANIFEST_NAME), "w") as fh:
+        fh.write("{torn mid-")
+    with pytest.raises(CheckpointCorruptError) as ei:
+        ckpt.restore(_toy_state(0.0), step=1)
+    assert ei.value.reason == "manifest_malformed"
+    ckpt.close()
+
+
+def test_strict_refuses_manifestless_but_default_tolerates(tmp_path):
+    """Pre-manifest checkpoints stay restorable (legacy tolerance);
+    the rollout verify gate's strict mode refuses them."""
+    root = str(tmp_path / "ck")
+    ckpt = CheckpointManager(root)
+    ckpt.save(1, _toy_state(1.0), block=True)
+    os.unlink(os.path.join(root, "1", MANIFEST_NAME))
+    with pytest.raises(CheckpointCorruptError) as ei:
+        ckpt.restore(_toy_state(0.0), step=1, strict=True)
+    assert ei.value.reason == "manifest_missing"
+    restored = ckpt.restore(_toy_state(0.0), step=1)  # legacy path
+    np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                  np.full(4, 1.0))
+    # identity still computed (not verified) for observability
+    assert ckpt.last_weights_digest is not None
+    ckpt.close()
+
+
+def test_injected_checkpoint_fault_kinds(tmp_path):
+    """``checkpoint_corrupt@N`` / ``manifest_missing@N`` drill both
+    failure paths deterministically on the 1-based verify counter."""
+    root = str(tmp_path / "ck")
+    writer = CheckpointManager(root)
+    writer.save(1, _toy_state(1.0), block=True)
+    writer.close()
+
+    plan = FaultPlan.parse("checkpoint_corrupt@1")
+    ckpt = CheckpointManager(root, fault_plan=plan)
+    with pytest.raises(CheckpointCorruptError) as ei:
+        ckpt.restore(_toy_state(0.0), step=1)
+    assert ei.value.reason == "injected"
+    # fire-once: the second verification succeeds
+    assert int(ckpt.restore(_toy_state(0.0), step=1)["step"]) == 1
+    ckpt.close()
+
+    plan = FaultPlan.parse("manifest_missing@1")
+    ckpt = CheckpointManager(root, fault_plan=plan)
+    with pytest.raises(CheckpointCorruptError) as ei:
+        ckpt.restore(_toy_state(0.0), step=1, strict=True)
+    assert ei.value.reason == "manifest_missing"
+    ckpt.close()
+
+
+def test_restore_walk_distinguishes_corrupt_from_absent(tmp_path):
+    """The newest-first walk lands on the older step either way, but a
+    CORRUPT (not merely absent) skip is observable: the
+    ``ckpt_corrupt_skipped`` event + counter fire only for damage."""
+    import shutil
+
+    root = str(tmp_path / "ck")
+    writer = CheckpointManager(root)
+    writer.save(1, _toy_state(1.0), block=True)
+    writer.save(2, _toy_state(2.0), block=True)
+    writer.close()
+
+    # absent: the step-2 item directory is gone entirely -> a routine
+    # hole in the walk, no corruption signal
+    moved = os.path.join(str(tmp_path), "stash")
+    shutil.move(os.path.join(root, "2", "default"), moved)
+    reg, events = MetricsRegistry(), _Events()
+    ckpt = CheckpointManager(root, registry=reg, events=events)
+    assert int(ckpt.restore(_toy_state(0.0))["step"]) == 1
+    assert reg.value("ckpt_corrupt_skipped_total") == 0
+    assert events.of("ckpt_corrupt_skipped") == []
+    ckpt.close()
+
+    # corrupt: step 2 exists but its manifest lies about the leaves
+    shutil.move(moved, os.path.join(root, "2", "default"))
+    mpath = os.path.join(root, "2", MANIFEST_NAME)
+    with open(mpath, encoding="utf-8") as fh:
+        manifest = json.load(fh)
+    manifest["leaves"]["w"]["sha256"] = "f" * 64
+    with open(mpath, "w", encoding="utf-8") as fh:
+        json.dump(manifest, fh)
+    reg, events = MetricsRegistry(), _Events()
+    ckpt = CheckpointManager(root, registry=reg, events=events)
+    assert int(ckpt.restore(_toy_state(0.0))["step"]) == 1
+    assert reg.value("ckpt_corrupt_skipped_total") == 1
+    skipped = events.of("ckpt_corrupt_skipped")
+    assert len(skipped) == 1 and skipped[0]["step"] == 2
+    assert skipped[0]["reason"] == "leaf_hash_mismatch"
+    # an explicitly requested corrupt step still fails loudly
+    with pytest.raises(CheckpointCorruptError):
+        ckpt.restore(_toy_state(0.0), step=2)
+    ckpt.close()
+
+
+def test_rollout_config_validation():
+    with pytest.raises(ValueError, match="golden_set_size"):
+        RolloutConfig(golden_set_size=0)
+    with pytest.raises(ValueError, match="canary_tolerance"):
+        RolloutConfig(canary_tolerance=-1.0)
+    with pytest.raises(ValueError, match="replica_timeout_s"):
+        RolloutConfig(replica_timeout_s=0.0)
+    # rollout is an explicit operator decision, off by default
+    assert ServeConfig().rollout.enabled is False
+
+
+# ---------------------------------------------------------------------------
+# 2+3. the canary gate and the rolling replace (fake engines — no jax)
+# ---------------------------------------------------------------------------
+
+
+def _fleet_cfg(**fleet_kw):
+    fleet = dict(
+        queue_depth=64, stream_window=8,
+        rewarm_backoff_s=0.05, rewarm_backoff_max_s=1.0,
+        class_deadline_ms={"interactive": 10_000.0, "batch": 20_000.0},
+    )
+    fleet.update(fleet_kw)
+    return Config(serve=ServeConfig(
+        batch_buckets=[1], src_buckets=[16], mel_buckets=[64],
+        frames_per_phoneme=2, max_wait_ms=5.0,
+        fleet=FleetConfig(**fleet),
+    ))
+
+
+class ConstMelEngine:
+    """Fake replica engine whose every result carries a constant mel —
+    the canary parity gate sees exactly the weight change we dial in."""
+
+    def __init__(self, const):
+        self.const = const
+
+    def precompile(self):
+        return 0.0
+
+    def run(self, requests):
+        mel = np.full((6, 8), self.const, np.float32)
+        return [SimpleNamespace(id=r.id, bucket=None, mel_len=6, mel=mel)
+                for r in requests]
+
+
+def _vfactory(const, built):
+    def build(reg):
+        eng = ConstMelEngine(const)
+        built.append(eng)
+        return eng
+
+    return build
+
+
+def _req(i, L=8, T=4, **kw):
+    return SynthesisRequest(
+        id=f"r{i}", sequence=np.ones(L, np.int32),
+        ref_mel=np.zeros((T, 80), np.float32), **kw,
+    )
+
+
+def _rcfg(**kw):
+    args = dict(golden_set_size=2, canary_tolerance=0.5,
+                replica_timeout_s=20.0)
+    args.update(kw)
+    return RolloutConfig(**args)
+
+
+_GOLDEN = [_req(900), _req(901)]
+
+
+def _vab(const, built, step_info=True):
+    """A verify_and_build stub returning a pinned-constant factory."""
+
+    def verify_and_build(step):
+        info = {"step": step, "weights_digest": f"dig{const}"} \
+            if step_info else {}
+        return _vfactory(const, built), f"v{step}", info
+
+    return verify_and_build
+
+
+def test_make_golden_set_is_seeded_and_lattice_sized():
+    cfg = _fleet_cfg()
+    object.__setattr__(cfg.serve, "batch_buckets", [1, 4])
+    a = make_golden_set(cfg, 3, seed=7)
+    b = make_golden_set(cfg, 3, seed=7)
+    assert [r.id for r in a] == ["golden0", "golden1", "golden2"]
+    for ra, rb in zip(a, b):
+        np.testing.assert_array_equal(ra.sequence, rb.sequence)
+        np.testing.assert_array_equal(ra.ref_mel, rb.ref_mel)
+        assert ra.sequence.shape[0] <= cfg.serve.src_buckets[0]
+    c = make_golden_set(cfg, 3, seed=8)
+    assert not np.array_equal(a[0].sequence, c[0].sequence)
+
+
+def test_make_golden_set_clamps_to_largest_batch_bucket():
+    # the set replays as ONE batch through the lattice: a size above the
+    # largest batch bucket must clamp, not crash the canary gate with
+    # RequestTooLarge (found live: the default golden_set_size of 4
+    # against a tiny serve lattice with batch_buckets [1, 2])
+    cfg = _fleet_cfg()  # batch_buckets [1]
+    assert len(make_golden_set(cfg, 4, seed=7)) == 1
+
+
+def test_canary_pass_commits_and_publishes_version():
+    built_v1, built_v2 = [], []
+    reg, events = MetricsRegistry(), _Events()
+    router = FleetRouter(_vfactory(0.0, built_v1), _fleet_cfg(),
+                         replicas=2, registry=reg, events=events)
+    assert router.wait_ready(timeout=10, n=2)
+    mgr = RolloutManager(router, _vab(0.1, built_v2), rcfg=_rcfg(),
+                         golden=_GOLDEN)
+    result = mgr.rollout(7)
+    assert result["status"] == "committed" and result["version"] == "v7"
+    assert result["replicas"] == 2
+    # identity published three ways: router attrs, the gauge, the event
+    assert router.model_version == "v7" and router.model_step == 7
+    assert reg.value("serve_model_version") == 7
+    assert reg.value("serve_rollouts_total", {"outcome": "committed"}) == 1
+    assert events.kinds().count("rollout_committed") == 1
+    canary = events.of("rollout_canary")
+    assert len(canary) == 1 and canary[0]["passed"] is True
+    # every READY replica now runs an engine built by the NEW factory
+    ready = [i for i, s in router.states().items() if s == READY]
+    assert len(ready) == 2
+    for i in ready:
+        assert router.engine_at(i) in built_v2
+    # both original replicas were drain-replaced
+    assert sorted(s for s in router.states().values()
+                  if s == STOPPED) == [STOPPED, STOPPED]
+    # future re-warms build the new version too
+    assert router.engine_factory(reg) in built_v2
+    router.close()
+
+
+@pytest.mark.parametrize("bad_const,why", [
+    (np.nan, "non-finite"),
+    (10.0, "tolerance"),
+])
+def test_canary_failure_aborts_with_fleet_untouched(bad_const, why):
+    built_v1, built_v2 = [], []
+    reg, events = MetricsRegistry(), _Events()
+    router = FleetRouter(_vfactory(0.0, built_v1), _fleet_cfg(),
+                         replicas=2, registry=reg, events=events)
+    assert router.wait_ready(timeout=10, n=2)
+    factory_before = router.engine_factory
+    mgr = RolloutManager(router, _vab(bad_const, built_v2), rcfg=_rcfg(),
+                         golden=_GOLDEN)
+    result = mgr.rollout(8)
+    assert result["status"] == "aborted" and result["phase"] == "canary"
+    assert why in result["reason"]
+    # the fleet is untouched: original replicas READY, factory and
+    # version unchanged, the canary drained away
+    assert router.engine_factory is factory_before
+    assert router.model_version is None
+    states = router.states()
+    assert [states[0], states[1]] == [READY, READY]
+    assert states[2] == STOPPED  # the canary surge replica
+    assert reg.value("serve_rollouts_total", {"outcome": "aborted"}) == 1
+    aborted = events.of("rollout_aborted")
+    assert len(aborted) == 1 and aborted[0]["phase"] == "canary"
+    assert aborted[0]["partial"] is False
+    assert not router.rollout_active
+    router.close()
+
+
+def test_canary_exception_aborts_and_drains_canary():
+    """An engine that RAISES during the canary replay (vs returning bad
+    mels) must abort like any failed gate — not escape rollout() as a
+    500 and leak a READY canary serving uncommitted weights (found
+    live: RequestTooLarge from an oversized golden set)."""
+    built_v1, built_v2 = [], []
+    reg, events = MetricsRegistry(), _Events()
+    router = FleetRouter(_vfactory(0.0, built_v1), _fleet_cfg(),
+                         replicas=2, registry=reg, events=events)
+    assert router.wait_ready(timeout=10, n=2)
+    factory_before = router.engine_factory
+
+    class _BoomEngine:
+        def precompile(self):
+            return 0.0
+
+        def run(self, requests):
+            raise RuntimeError("boom during canary replay")
+
+    def boom_vab(step):
+        def build(reg):
+            eng = _BoomEngine()
+            built_v2.append(eng)
+            return eng
+
+        return build, f"v{step}", {"step": step, "weights_digest": "d"}
+
+    mgr = RolloutManager(router, boom_vab, rcfg=_rcfg(), golden=_GOLDEN)
+    result = mgr.rollout(8)
+    assert result["status"] == "aborted" and result["phase"] == "canary"
+    assert "RuntimeError: boom" in result["reason"]
+    assert router.engine_factory is factory_before
+    assert router.model_version is None
+    states = router.states()
+    assert [states[0], states[1]] == [READY, READY]
+    assert states[2] == STOPPED  # the canary was torn down, not leaked
+    assert reg.value("serve_rollouts_total", {"outcome": "aborted"}) == 1
+    assert not router.rollout_active
+    router.close()
+
+
+def test_verify_failure_aborts_before_any_replica_exists():
+    built_v1 = []
+    reg, events = MetricsRegistry(), _Events()
+    router = FleetRouter(_vfactory(0.0, built_v1), _fleet_cfg(),
+                         replicas=2, registry=reg, events=events)
+    assert router.wait_ready(timeout=10, n=2)
+
+    def bad_vab(step):
+        raise CheckpointCorruptError(step, "leaf_hash_mismatch", "drill")
+
+    mgr = RolloutManager(router, bad_vab, rcfg=_rcfg(), golden=_GOLDEN)
+    result = mgr.rollout(9)
+    assert result["status"] == "aborted" and result["phase"] == "verify"
+    assert "CheckpointCorruptError" in result["reason"]
+    assert len(router.states()) == 2  # no canary was ever started
+    assert sorted(router.states().values()) == [READY, READY]
+    assert not router.rollout_active
+    router.close()
+
+
+def test_rolling_replace_zero_lost_under_load():
+    """The acceptance invariant: a full rollout under closed-loop load
+    loses ZERO requests and READY never dips below the pre-roll size
+    (the canary is the +1 surge)."""
+    built_v1, built_v2 = [], []
+    reg = MetricsRegistry()
+    router = FleetRouter(_vfactory(0.0, built_v1), _fleet_cfg(),
+                         replicas=2, registry=reg)
+    assert router.wait_ready(timeout=10, n=2)
+    mgr = RolloutManager(router, _vab(0.1, built_v2), rcfg=_rcfg(),
+                         golden=_GOLDEN)
+    stop = threading.Event()
+    per = [dict(ok=0, lost=[]) for _ in range(4)]
+    min_ready = [99]
+
+    def sampler():
+        while not stop.is_set():
+            ready = sum(1 for s in router.states().values() if s == READY)
+            min_ready[0] = min(min_ready[0], ready)
+            time.sleep(0.001)
+
+    def client(cid):
+        c, i = per[cid], 0
+        while not stop.is_set():
+            try:
+                res = router.submit(_req(cid * 100_000 + i)).result(
+                    timeout=10)
+                assert res is not None
+                c["ok"] += 1
+            except Exception as e:
+                c["lost"].append(f"{type(e).__name__}: {e}")
+            i += 1
+
+    threads = [threading.Thread(target=sampler, daemon=True)]
+    threads += [threading.Thread(target=client, args=(c,), daemon=True)
+                for c in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)  # load flowing before the roll begins
+    result = mgr.rollout(2)
+    time.sleep(0.05)  # and keeps flowing on the new fleet
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert result["status"] == "committed"
+    assert [c["lost"] for c in per] == [[], [], [], []]
+    assert sum(c["ok"] for c in per) > 0
+    assert min_ready[0] >= 2
+    assert reg.value("serve_model_version") == 2
+    router.close()
+
+
+def test_concurrent_rollout_raises_in_progress():
+    built = []
+    router = FleetRouter(_vfactory(0.0, built), _fleet_cfg(),
+                         replicas=1, registry=MetricsRegistry())
+    assert router.wait_ready(timeout=10, n=1)
+    entered, gate = threading.Event(), threading.Event()
+
+    def blocking_vab(step):
+        entered.set()
+        assert gate.wait(timeout=10)
+        raise RuntimeError("released")
+
+    mgr = RolloutManager(router, blocking_vab, rcfg=_rcfg(),
+                         golden=_GOLDEN)
+    first = {}
+    t = threading.Thread(
+        target=lambda: first.update(mgr.rollout(2)), daemon=True
+    )
+    t.start()
+    assert entered.wait(timeout=10)
+    assert router.rollout_active
+    with pytest.raises(RolloutInProgress):
+        mgr.rollout(3)
+    gate.set()
+    t.join(timeout=10)
+    assert first["status"] == "aborted"
+    assert not router.rollout_active
+    router.close()
+
+
+# ---------------------------------------------------------------------------
+# 3b. autoscaler coordination: rollout_active holds scale-downs
+# ---------------------------------------------------------------------------
+
+
+class FakeRouter:
+    """Signal-surface stand-in (as tests/test_traffic.py uses)."""
+
+    def __init__(self, queue_depth=100, replicas=1):
+        self.fleet = SimpleNamespace(queue_depth=queue_depth)
+        self.registry = MetricsRegistry()
+        self.events = None
+        self.depth = 0
+        self.occ = 0.0
+        self.live = replicas
+        self.warmup = None
+        self.scale_calls = []
+        self.rollout_active = False
+
+    def pending_depth(self):
+        return self.depth
+
+    def live_replica_count(self):
+        return self.live
+
+    def occupancy(self):
+        return self.occ
+
+    def warmup_cost_s(self):
+        return self.warmup
+
+    def scale_to(self, n):
+        self.scale_calls.append(n)
+        self.live = n
+
+
+def _acfg(**kw):
+    args = dict(enabled=True, min_replicas=1, max_replicas=4,
+                interval_s=0.1, up_queue_fraction=0.5, up_occupancy=0.9,
+                up_pressure_rate=1.0, down_queue_fraction=0.05,
+                down_occupancy=0.5, down_stable_s=1.0, cooldown_up_s=2.0,
+                cooldown_down_s=3.0, max_step=2, assumed_warmup_s=0.5,
+                warmup_cost_factor=1.0)
+    args.update(kw)
+    return AutoscaleConfig(**args)
+
+
+def test_autoscaler_holds_calm_scaledown_during_rollout():
+    router = FakeRouter(replicas=2)
+    scaler = Autoscaler(router, _acfg(), start=False)
+    assert scaler.step(now=100.0) is None      # calm streak starts
+    router.rollout_active = True
+    # calm window elapsed, but a rollout is live: hold AND restart the
+    # streak so the roll's end does not inherit pre-roll calm
+    assert scaler.step(now=101.5) is None
+    assert router.scale_calls == []
+    router.rollout_active = False
+    assert scaler.step(now=102.0) is None      # streak restarted
+    assert scaler.step(now=103.5) == "calm"    # full window re-served
+    assert router.scale_calls == [1]
+
+
+def test_autoscaler_holds_max_bound_during_rollout_surge():
+    """The canary surge may sit at max_replicas + 1; the bound
+    correction must not drain it mid-roll."""
+    router = FakeRouter(replicas=5)            # over max_replicas=4
+    scaler = Autoscaler(router, _acfg(), start=False)
+    router.rollout_active = True
+    assert scaler.step(now=100.0) is None
+    assert router.scale_calls == []
+    router.rollout_active = False
+    assert scaler.step(now=101.0) == "max_bound"
+    assert router.scale_calls == [4]
+
+
+def test_autoscaler_still_scales_up_during_rollout():
+    """An upgrade under pressure still grows: only DOWNS are held."""
+    router = FakeRouter(queue_depth=100, replicas=2)
+    scaler = Autoscaler(router, _acfg(), start=False)
+    router.rollout_active = True
+    router.depth = 50                          # at the up watermark
+    assert scaler.step(now=100.0) == "queue_depth"
+    assert router.scale_calls == [3]
+
+
+# ---------------------------------------------------------------------------
+# 4. the HTTP surface
+# ---------------------------------------------------------------------------
+
+
+def _start_server(router, lifecycle=None):
+    from speakingstyle_tpu.serving.server import SynthesisServer, TextFrontend
+
+    server = SynthesisServer(
+        frontend=TextFrontend(router.cfg, np.zeros((4, 80), np.float32)),
+        host="127.0.0.1", port=0, router=router, lifecycle=lifecycle,
+    )
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+def _post(host, port, path, body, timeout=30):
+    import http.client
+
+    conn = http.client.HTTPConnection(host, port, timeout=timeout)
+    conn.request("POST", path, body=body)
+    resp = conn.getresponse()
+    out = (resp.status, json.loads(resp.read() or b"{}"))
+    conn.close()
+    return out
+
+
+def test_http_rollout_404_when_not_enabled():
+    router = FleetRouter(_vfactory(0.0, []), _fleet_cfg(), replicas=1,
+                         registry=MetricsRegistry())
+    assert router.wait_ready(timeout=10, n=1)
+    server = _start_server(router, lifecycle=None)
+    host, port = server.address[:2]
+    try:
+        status, body = _post(host, port, "/admin/rollout",
+                             json.dumps({"step": 2}))
+        assert status == 404 and "not enabled" in body["error"]
+    finally:
+        server.shutdown()
+
+
+def test_http_rollout_validation_conflict_and_outcomes():
+    """One server, the whole admin contract: 400 on malformed input,
+    409 while a rollout is in flight, 200 for both aborted and
+    committed outcomes, and the committed version in /healthz."""
+    import http.client
+
+    built = []
+    reg = MetricsRegistry()
+    router = FleetRouter(_vfactory(0.0, built), _fleet_cfg(),
+                         replicas=2, registry=reg)
+    assert router.wait_ready(timeout=10, n=2)
+    entered, gate = threading.Event(), threading.Event()
+
+    def vab(step):
+        if step == 2:        # the blocked-then-refused candidate
+            entered.set()
+            assert gate.wait(timeout=30)
+            raise RuntimeError("bad checkpoint")
+        return _vfactory(0.1, built), f"v{step}", \
+            {"step": step, "weights_digest": "digest5"}
+
+    lifecycle = RolloutManager(router, vab, rcfg=_rcfg(), golden=_GOLDEN)
+    server = _start_server(router, lifecycle=lifecycle)
+    host, port = server.address[:2]
+    try:
+        # -- validation
+        status, body = _post(host, port, "/admin/rollout", "not json")
+        assert status == 400 and "JSON" in body["error"]
+        for payload in ({}, {"step": "2"}, {"step": True}):
+            status, body = _post(host, port, "/admin/rollout",
+                                 json.dumps(payload))
+            assert status == 400 and "step" in body["error"]
+
+        # -- 409 while a rollout holds the lock
+        first = {}
+
+        def long_post():
+            first.update(dict(zip(
+                ("status", "body"),
+                _post(host, port, "/admin/rollout",
+                      json.dumps({"step": 2}), timeout=60),
+            )))
+
+        t = threading.Thread(target=long_post, daemon=True)
+        t.start()
+        assert entered.wait(timeout=10)
+        status, body = _post(host, port, "/admin/rollout",
+                             json.dumps({"step": 3}))
+        assert status == 409 and "in progress" in body["error"]
+        gate.set()
+        t.join(timeout=30)
+        # the refused candidate still answers 200 with the outcome dict
+        assert first["status"] == 200
+        assert first["body"]["status"] == "aborted"
+        assert first["body"]["phase"] == "verify"
+
+        # -- a clean rollout commits over the same surface
+        status, body = _post(host, port, "/admin/rollout",
+                             json.dumps({"step": 5}), timeout=60)
+        assert status == 200 and body["status"] == "committed"
+        assert body["version"] == "v5" and body["step"] == 5
+
+        # -- /healthz now carries the model identity block
+        conn = http.client.HTTPConnection(host, port, timeout=30)
+        conn.request("GET", "/healthz")
+        resp = conn.getresponse()
+        health = json.loads(resp.read())
+        conn.close()
+        assert resp.status == 200
+        assert health["model"] == {
+            "version": "v5", "step": 5, "weights_digest": "digest5",
+        }
+        assert server.model_version() == "v5"
+    finally:
+        server.shutdown()
