@@ -190,6 +190,23 @@ def _device_watchdog(timeout_s: float, out_factory):
 DEVICE_ACQUISITION_TIMEOUT_S = 60.0
 
 
+def _bench_registry():
+    """One ProgramRegistry per bench process: wires the persistent
+    compile cache (.jax_cache — the driver re-runs bench every round and
+    the tunneled-TPU AOT compile is the slowest part; warm runs skip it)
+    and owns every AOT compile below (bench_compiles_total,
+    jax_persistent_cache_{hits,requests}_total)."""
+    from speakingstyle_tpu.parallel.registry import ProgramRegistry
+
+    return ProgramRegistry(
+        cache_dir=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
+        ),
+        counter_name="bench_compiles_total",
+        prefix="bench",
+    )
+
+
 def main(report_flops: bool = False, profile: bool = False,
          overrides: dict = None):
     _mark("importing jax")
@@ -206,14 +223,7 @@ def main(report_flops: bool = False, profile: bool = False,
     # XLA-native RBG PRNG for dropout masks (TrainConfig.fast_prng):
     # threefry mask generation alone cost ~15% of the v5e step time.
     jax.config.update("jax_default_prng_impl", "rbg")
-    # Persistent compile cache: the driver re-runs this every round and the
-    # tunneled-TPU AOT compile is the slowest part — warm runs skip it.
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    programs = _bench_registry()
     _mark("acquiring devices (tunneled-TPU backend init hangs here when sick)")
     acquired = _device_watchdog(
         DEVICE_ACQUISITION_TIMEOUT_S,
@@ -274,32 +284,33 @@ def main(report_flops: bool = False, profile: bool = False,
     copts = json.loads(os.environ.get("BENCH_COMPILER_OPTIONS", "null"))
 
     if report_flops:
-        # thin ProgramCard consumer: the same extraction the serving
-        # engine and the trainer use (obs/cost.py), so --flops, /debug/
-        # programs, and the program_card event can never disagree on
-        # what a program costs
-        from speakingstyle_tpu.obs.cost import ProgramCard
-
-        compiled = train_step.lower(state, batch, rng).compile(
-            compiler_options=copts
+        # thin registry-card consumer: the same extraction the serving
+        # engine and the trainer use (parallel/registry.py -> obs/cost.py),
+        # so --flops, /debug/programs, and the program_card event can
+        # never disagree on what a program costs
+        programs.compile(
+            train_step, (state, batch, rng), name="train_step",
+            compiler_options=copts,
         )
-        card = ProgramCard.from_compiled(compiled, name="train_step")
-        flops = card.flops if card.flops is not None else float("nan")
+        card = programs.card("train_step") or {}
+        flops = card.get("flops")
+        flops = flops if flops is not None else float("nan")
         out = {
             "metric": "train_step_flops",
             "value": flops,
             "unit": "FLOP/step",
             "per_frame_mflop": round(flops / (B * T_MEL) / 1e6, 1),
-            "program_card": card.as_dict(),
+            "program_card": card,
         }
         if copts:
             out["compiler_options"] = copts
         print(json.dumps(out))
         return
 
-    _mark("compile start (train_step.lower().compile())")
-    compiled = train_step.lower(state, batch, rng).compile(
-        compiler_options=copts
+    _mark("compile start (ProgramRegistry AOT compile)")
+    compiled = programs.compile(
+        train_step, (state, batch, rng), name="train_step",
+        compiler_options=copts,
     )
     _mark("compile end")
 
@@ -362,10 +373,7 @@ def run_breakdown():
     from speakingstyle_tpu.models.postnet import PostNet
 
     jax.config.update("jax_default_prng_impl", "rbg")
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
-    )
+    programs = _bench_registry()
     _require_tpu()
     cfg = _apply_overrides(Config(), TUNED_OVERRIDES)
     m = cfg.model
@@ -401,7 +409,9 @@ def run_breakdown():
                 )
             return jnp.sum(out.astype(jnp.float32))
 
-        g = jax.jit(jax.grad(loss_fn))
+        g = programs.compile(
+            jax.grad(loss_fn), (params,), name=f"breakdown:{name}"
+        )
         grads = g(params)
         float(jax.tree_util.tree_leaves(grads)[0].ravel()[0])  # D2H sync
         t0 = time.perf_counter()
@@ -430,11 +440,10 @@ def run_infer():
     from speakingstyle_tpu.models.factory import build_model, init_variables
     from speakingstyle_tpu.models.hifigan import Generator
 
+    from speakingstyle_tpu.parallel.registry import jit_program
+
     jax.config.update("jax_default_prng_impl", "rbg")
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
-    )
+    _bench_registry()  # persistent-cache + compile-bus wiring
     _require_tpu()
     cfg = _apply_overrides(Config(), TUNED_OVERRIDES)
     rng = np.random.default_rng(0)
@@ -461,7 +470,7 @@ def run_infer():
         k: v for k, v in make_batch(n_mels, rng).items()
         if k not in ("pitches", "energies", "durations")
     }
-    fwd = jax.jit(
+    fwd = jit_program(
         # max_mel_len is a static shape argument (the free-running mel
         # buffer length), so it is closed over rather than traced
         lambda v, b: model.apply(v, deterministic=True, **b,
@@ -481,7 +490,7 @@ def run_infer():
     Bv = 8
     mels = jnp.asarray(rng.standard_normal((Bv, T_MEL, n_mels)), jnp.float32)
     params = gen.init(jax.random.PRNGKey(0), mels)["params"]
-    voc = jax.jit(lambda p, m: gen.apply({"params": p}, m))
+    voc = jit_program(lambda p, m: gen.apply({"params": p}, m))
     dt, rt = time_realtime(voc, params, mels, n_frames=Bv * T_MEL)
     print(json.dumps({
         "metric": "hifigan_realtime_factor",
@@ -499,7 +508,7 @@ def run_infer():
     text = ("The quick brown fox jumps over the lazy dog and then runs "
             "far away into the quiet green hills beyond the river")
     T_lat = 640  # static mel buffer ~7.4 s of 22050 Hz audio at hop 256
-    fwd1 = jax.jit(
+    fwd1 = jit_program(
         lambda v, b: model.apply(v, deterministic=True, **b,
                                  max_mel_len=T_lat,
                                  mutable=["batch_stats"])[0]["mel_postnet"]
@@ -595,9 +604,14 @@ def _tiny_serve_config():
     )
 
 
-def _serve_engine(tiny: bool):
+def _serve_engine(tiny: bool, mesh=None):
     """(engine, model_label): tiny CPU engine, or the flagship config +
-    random weights on an accelerator (compute identical to trained)."""
+    random weights on an accelerator (compute identical to trained).
+    ``mesh=(dp, tp)`` makes the engine a mesh-slice replica: the lattice
+    compiles with explicit NamedShardings over a resolve_mesh slice —
+    the --mesh-serve sweep's subject."""
+    import dataclasses
+
     import numpy as np
 
     import jax
@@ -627,6 +641,13 @@ def _serve_engine(tiny: bool):
         cfg = _apply_overrides(Config(), TUNED_OVERRIDES)
         label = "flagship"
         vocoder = get_vocoder(cfg)
+    if mesh is not None:
+        from speakingstyle_tpu.configs.config import ParallelConfig
+
+        cfg = dataclasses.replace(cfg, serve=dataclasses.replace(
+            cfg.serve, parallel=ParallelConfig(mesh=list(mesh))
+        ))
+        label = f"{label}-{mesh[0]}x{mesh[1]}"
     lattice = BucketLattice.from_config(cfg.serve)
     n_position = max(lattice.max_mel, lattice.max_src,
                      cfg.model.max_seq_len) + 1
@@ -2457,6 +2478,173 @@ def run_multichip(device_counts=MULTICHIP_DEVICE_COUNTS):
         }))
 
 
+# ---------------------------------------------------------------------------
+# --mesh-serve: weak-scaling sweep over mesh-slice replica geometries
+# ---------------------------------------------------------------------------
+
+MESHSERVE_GEOMETRIES = ((1, 1), (2, 1), (2, 2), (1, 4))
+MESHSERVE_CLIENTS = 8
+# CPU-proxy caveat, same as --multichip: virtual devices exercise the
+# GSPMD partitioner + the sharded dispatch path exactly like real chips,
+# but collectives are memcpys — the sweep measures mesh-serving MACHINERY
+# overhead (resharding hops, per-dispatch device_puts, replicated-weight
+# broadcast), never kernel or ICI throughput. The 1x1 point normalizes.
+
+
+def _mesh_serve_child(dp: int, tp: int, duration: float = 3.0):
+    """One weak-scaling point; runs in a child process whose XLA_FLAGS
+    force dp*tp host devices. The tiny serve engine becomes a (dp, tp)
+    mesh slice (same resolve_mesh path as training), precompiles its
+    lattice through the ProgramRegistry, and serves closed-loop clients
+    through the ContinuousBatcher. Emits ONE JSON line; steady_compiles
+    MUST read zero — the registry invariant on sharded AOT programs."""
+    import numpy as np
+
+    import jax
+
+    from speakingstyle_tpu.obs import MetricsRegistry
+    from speakingstyle_tpu.serving.batcher import ContinuousBatcher
+    from speakingstyle_tpu.serving.engine import (
+        CompileMonitor,
+        SynthesisRequest,
+    )
+
+    geometry = f"{dp}x{tp}"
+    if len(jax.devices()) < dp * tp:
+        print(json.dumps({
+            "metric": "serve_mesh", "geometry": geometry, "qps": None,
+            "error": f"only {len(jax.devices())} devices visible",
+        }))
+        return
+    engine, label = _serve_engine(tiny=True, mesh=(dp, tp))
+    serve = engine.cfg.serve
+    rng = np.random.default_rng(0)
+    max_src = serve.src_buckets[-1]
+    max_len = min(max_src, serve.mel_buckets[-1] // serve.frames_per_phoneme)
+    max_ref = engine.style.lattice.max_ref if engine.style is not None else 8
+    hot_refs = [
+        rng.standard_normal(
+            (int(rng.integers(max(8, max_ref // 2), max_ref + 1)),
+             engine.n_mels)
+        ).astype(np.float32)
+        for _ in range(8)
+    ]
+
+    def make_request(i: int) -> SynthesisRequest:
+        L = int(rng.integers(max(4, max_len // 2), max_len + 1))
+        return SynthesisRequest(
+            id=f"mesh{i}",
+            sequence=rng.integers(1, 300, L).astype(np.int32),
+            ref_mel=hot_refs[i % len(hot_refs)],
+        )
+
+    secs = engine.precompile()
+    aot_programs = engine.compile_count
+    # warmup: one dispatch per batch bucket — first-execution transfers
+    # through dispatch_sharding's device_puts, zero further compiles
+    for b in engine.lattice.batch_buckets:
+        engine.run([make_request(10_000 + b * 100 + j) for j in range(b)])
+
+    point = MetricsRegistry()
+    batcher = ContinuousBatcher(engine, registry=point)
+    stop_at = time.perf_counter() + duration
+
+    def client(cid: int):
+        i = 0
+        while time.perf_counter() < stop_at:
+            req = make_request(cid * 1_000_000 + i)
+            try:
+                batcher.submit(req).result(timeout=60)
+            except Exception:
+                return
+            i += 1
+
+    with CompileMonitor() as mon:
+        threads = [
+            threading.Thread(target=client, args=(c,), daemon=True)
+            for c in range(MESHSERVE_CLIENTS)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        batcher.close()
+    hist = point.histogram("serve_request_latency_seconds")
+
+    def pct_ms(q):
+        p = hist.percentile(q)
+        return round(1e3 * p, 1) if p is not None else None
+
+    print(json.dumps({
+        "metric": "serve_mesh",
+        "geometry": geometry,
+        "mesh": [dp, tp],
+        "devices": dp * tp,
+        "clients": MESHSERVE_CLIENTS,
+        "qps": round(hist.count / dt, 2),
+        "p50_ms": pct_ms(0.50),
+        "p95_ms": pct_ms(0.95),
+        "aot_programs": aot_programs,
+        "precompile_s": round(secs, 1),
+        "steady_compiles": mon.count,
+        "model": label,
+        "platform": "cpu-proxy",
+    }))
+
+
+def run_mesh_serve(geometries=MESHSERVE_GEOMETRIES, duration: float = 3.0):
+    """The --mesh-serve sweep: one child process per (dp, tp) geometry,
+    each with ``--xla_force_host_platform_device_count={dp*tp}`` (the
+    flag only binds before the backend initializes, hence the re-exec —
+    run_multichip's pattern). Weak scaling over replica SHAPE: offered
+    load is fixed, the replica's mesh grows; on the CPU proxy the
+    meshserve_qps_{geometry} RATIO vs 1x1 is the metric (mesh-serving
+    machinery overhead), absolute QPS is not. Rides `--compare`."""
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    for dp, tp in geometries:
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            env.get("XLA_FLAGS", ""),
+        ).strip()
+        env["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={dp * tp}"
+        ).strip()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__),
+                 "--mesh-serve-inner", "--mesh", str(dp), str(tp),
+                 "--duration", str(duration)],
+                capture_output=True,
+                text=True,
+                timeout=600,
+                env=env,
+                cwd=here,
+            )
+        except subprocess.TimeoutExpired:
+            print(json.dumps({
+                "metric": "serve_mesh", "geometry": f"{dp}x{tp}",
+                "qps": None, "error": "timeout after 600s",
+            }))
+            continue
+        line = next(
+            (ln for ln in reversed(proc.stdout.strip().splitlines())
+             if ln.startswith("{")),
+            None,
+        )
+        print(line or json.dumps({
+            "metric": "serve_mesh", "geometry": f"{dp}x{tp}", "qps": None,
+            "error": f"rc={proc.returncode}: {proc.stderr[-300:]}",
+        }))
+
+
 REGRESSION_THRESHOLD = 0.10
 
 
@@ -2546,6 +2734,18 @@ def _absorb_record(rec, metrics):
         if isinstance(rec.get("frames_per_sec_per_chip"), (int, float)):
             metrics[f"multichip_frames_per_s_per_chip_{n}d"] = (
                 float(rec["frames_per_sec_per_chip"]), "higher")
+    elif m == "serve_mesh":
+        # per-geometry QPS of a mesh-slice replica; steady_compiles rides
+        # as lower-is-better (its floor — and expected value — is zero)
+        g = rec.get("geometry")
+        if isinstance(rec.get("qps"), (int, float)):
+            metrics[f"meshserve_qps_{g}"] = (float(rec["qps"]), "higher")
+        if isinstance(rec.get("p95_ms"), (int, float)):
+            metrics[f"meshserve_p95_ms_{g}"] = (float(rec["p95_ms"]),
+                                                "lower")
+        if isinstance(rec.get("steady_compiles"), (int, float)):
+            metrics[f"meshserve_steady_compiles_{g}"] = (
+                float(rec["steady_compiles"]), "lower")
     elif m == "serve_style_cache_qps_gain":
         if isinstance(rec.get("value"), (int, float)):
             metrics[m] = (float(rec["value"]), "higher")
@@ -2772,6 +2972,7 @@ if __name__ == "__main__":
         run_chaos(duration=dur)
         run_traffic(duration=dur)
         run_rollout(duration=dur)
+        run_mesh_serve(duration=dur)
     elif "--rollout" in sys.argv:
         dur = (float(sys.argv[sys.argv.index("--duration") + 1])
                if "--duration" in sys.argv else 3.0)
@@ -2802,6 +3003,16 @@ if __name__ == "__main__":
         _multichip_child(int(sys.argv[sys.argv.index("--n-devices") + 1]))
     elif "--multichip" in sys.argv:
         run_multichip()
+    elif "--mesh-serve-inner" in sys.argv:
+        i = sys.argv.index("--mesh")
+        dur = (float(sys.argv[sys.argv.index("--duration") + 1])
+               if "--duration" in sys.argv else 3.0)
+        _mesh_serve_child(int(sys.argv[i + 1]), int(sys.argv[i + 2]),
+                          duration=dur)
+    elif "--mesh-serve" in sys.argv:
+        dur = (float(sys.argv[sys.argv.index("--duration") + 1])
+               if "--duration" in sys.argv else 3.0)
+        run_mesh_serve(duration=dur)
     elif "--compare" in sys.argv:
         i = sys.argv.index("--compare")
         rest = [a for a in sys.argv[i + 1:] if not a.startswith("--")]

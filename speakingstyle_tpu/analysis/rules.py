@@ -69,6 +69,15 @@ JL017  non-atomic persistent writes under training/ or serving/:
        os.replace in the enclosing scope — a crash mid-write leaves a
        torn file that reads as CORRUPT, not absent; durable artifacts
        must appear atomically (write <name>.tmp, fsync, os.replace)
+JL018  XLA compilation outside the program registry: any reference to
+       jax.jit/jax.pjit (call, decorator, functools.partial argument,
+       bare attribute), a ``from jax import jit/pjit`` import, or a
+       .lower().compile() AOT chain anywhere under speakingstyle_tpu/
+       (plus bench.py) except parallel/registry.py — the registry is
+       the one guarded compile entry point (ProgramRegistry.compile
+       for AOT, jit_program for jit-on-call wrappers), which is what
+       makes the zero-steady-state-compiles invariant structural;
+       precompile/warmup fixtures are exempt. Tree baseline: zero.
 """
 
 import ast
@@ -119,11 +128,15 @@ _ARRAY_PRODUCER_PREFIXES = (
 )
 _ARRAY_PRODUCER_SUFFIXES = (".apply", ".init")
 
-# jax transforms whose function argument is traced
+# jax transforms whose function argument is traced (jit_program is the
+# registry's sanctioned jax.jit alias — parallel/registry.py)
 _TRACING_TRANSFORMS = {
     "jax.jit", "jax.grad", "jax.value_and_grad", "jax.vmap", "jax.pmap",
-    "jax.checkpoint", "jax.remat",
+    "jax.checkpoint", "jax.remat", "jit_program",
 }
+
+# spellings that construct a jit-on-call wrapper (JL003's call sites)
+_JIT_CONSTRUCTORS = {"jax.jit", "jit_program"}
 
 _STATE_PARAM_NAMES = {"state", "variables", "params", "opt_state", "carry"}
 
@@ -499,12 +512,14 @@ def rule_jl002(mod: ModuleInfo) -> Iterator[Finding]:
 def _jit_callsites(mod: ModuleInfo):
     """Yield (call_node, callee_fndef_or_None, jit_kwargs, decorated_fn).
 
-    Covers ``jax.jit(f, **kw)`` calls, ``@jax.jit`` and
+    Covers ``jax.jit(f, **kw)``/``jit_program(f, **kw)`` calls,
+    ``@jax.jit``/``@jit_program`` and
     ``@functools.partial(jax.jit, **kw)`` decorations.
     """
     defs = {f.name: f for f in mod.functions}
     for node in ast.walk(mod.tree):
-        if isinstance(node, ast.Call) and _dotted(node.func) == "jax.jit":
+        if isinstance(node, ast.Call) and \
+                _dotted(node.func) in _JIT_CONSTRUCTORS:
             target = None
             if node.args and isinstance(node.args[0], ast.Name):
                 target = defs.get(node.args[0].id)
@@ -512,14 +527,14 @@ def _jit_callsites(mod: ModuleInfo):
             yield node, target, kwargs, None
     for fn in mod.functions:
         for dec in fn.decorator_list:
-            if _dotted(dec) == "jax.jit":
+            if _dotted(dec) in _JIT_CONSTRUCTORS:
                 yield dec, fn, set(), fn
             elif isinstance(dec, ast.Call):
                 dc = _dotted(dec.func)
-                if dc == "jax.jit":
+                if dc in _JIT_CONSTRUCTORS:
                     yield dec, fn, {k.arg for k in dec.keywords if k.arg}, fn
                 elif dc in ("functools.partial", "partial") and dec.args and \
-                        _dotted(dec.args[0]) == "jax.jit":
+                        _dotted(dec.args[0]) in _JIT_CONSTRUCTORS:
                     yield dec, fn, {k.arg for k in dec.keywords if k.arg}, fn
 
 
@@ -1114,7 +1129,7 @@ def rule_jl007(mod: ModuleInfo) -> Iterator[Finding]:
 # JL008 — compile in hot path
 # ---------------------------------------------------------------------------
 
-_JIT_CALL_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit"}
+_JIT_CALL_NAMES = {"jax.jit", "jit", "jax.pjit", "pjit", "jit_program"}
 # functions sanctioned to compile in a loop: the AOT startup pattern
 # (serving/engine.py precompile) — hoist compiles INTO one of these
 _COMPILE_EXEMPT_MARKERS = ("precompile", "warmup", "warm_up")
@@ -1935,6 +1950,84 @@ def rule_jl017(mod: ModuleInfo) -> Iterator[Finding]:
         )
 
 
+# ---------------------------------------------------------------------------
+# JL018 — XLA compilation outside the program registry
+# ---------------------------------------------------------------------------
+
+
+_RAW_JIT_SPELLINGS = {"jax.jit", "jax.pjit", "jax.experimental.pjit.pjit"}
+_JIT_IMPORT_NAMES = {"jit", "pjit"}
+_REGISTRY_PATH_MARKER = "parallel/registry.py"
+
+
+def _jl018_in_scope(path: str) -> bool:
+    """The enforced tree: the package itself plus bench.py. Tests,
+    scripts/, and anything outside the package may spell jax.jit (their
+    compiles are fixtures, not production programs)."""
+    p = path.replace("\\", "/")
+    if _REGISTRY_PATH_MARKER in p:
+        return False
+    return "speakingstyle_tpu/" in p or os.path.basename(p) == "bench.py"
+
+
+def rule_jl018(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL018: XLA compilation outside ``parallel/registry.py`` — a
+    reference to ``jax.jit``/``jax.pjit`` (call, decorator,
+    ``functools.partial`` argument, or bare attribute), a
+    ``from jax import jit``-style import, or a ``.lower(...).compile()``
+    AOT chain, anywhere under ``speakingstyle_tpu/`` or in ``bench.py``.
+
+    The ProgramRegistry (parallel/registry.py) is the ONE guarded entry
+    point where XLA programs are built: it owns the cache-key semantics
+    ("did we already build this program?" has one answer), the compile
+    counters, the persistent-cache hookup, and the sharding-spec card
+    table behind ``GET /debug/programs``. A stray ``jax.jit`` anywhere
+    else re-opens a side door the zero-steady-state-compiles invariant
+    (JL008) cannot see through. Route AOT compiles through
+    ``ProgramRegistry.compile`` and jit-on-first-call wrappers through
+    ``jit_program``. Functions named ``precompile``/``warmup`` are
+    exempt (startup fixtures); the tree baseline for this rule is zero
+    and must stay zero.
+    """
+    if not _jl018_in_scope(mod.path):
+        return
+
+    def _exempt(node: ast.AST) -> bool:
+        qual = mod.qualname(node)
+        return any(m in qual.lower() for m in _COMPILE_EXEMPT_MARKERS)
+
+    def _finding(node: ast.AST, what: str) -> Finding:
+        return Finding(
+            rule="JL018",
+            path=mod.path,
+            line=node.lineno,
+            context=mod.qualname(node),
+            detail=f"{what} outside registry",
+            message=(
+                f"`{what}` outside parallel/registry.py "
+                f"({mod.qualname(node)}): the ProgramRegistry is the one "
+                "compile entry point — use ProgramRegistry.compile for "
+                "AOT programs or jit_program for jit-on-call wrappers "
+                "so cache keys, compile counters, persistent-cache "
+                "wiring, and /debug/programs cards stay complete."
+            ),
+        )
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ImportFrom):
+            if node.module and node.module.split(".")[0] == "jax":
+                for alias in node.names:
+                    if alias.name in _JIT_IMPORT_NAMES:
+                        yield _finding(node, f"from {node.module} "
+                                             f"import {alias.name}")
+        elif isinstance(node, ast.Attribute):
+            if _dotted(node) in _RAW_JIT_SPELLINGS and not _exempt(node):
+                yield _finding(node, _dotted(node))
+        elif isinstance(node, ast.Call):
+            if _is_aot_compile_chain(node) and not _exempt(node):
+                yield _finding(node, ".lower().compile()")
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -1953,4 +2046,5 @@ RULES = {
     "JL015": rule_jl015,
     "JL016": rule_jl016,
     "JL017": rule_jl017,
+    "JL018": rule_jl018,
 }

@@ -14,14 +14,13 @@ Fourier basis: on TPU the rfft lowers to XLA's native FFT and the windowing
 fuses, so there is no materialized [n_fft, n_fft] basis matmul.
 """
 
-import functools
 from typing import Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from speakingstyle_tpu.audio.mel import mel_filterbank
+from speakingstyle_tpu.parallel.registry import jit_program
 
 
 def hann_window(win_length: int, n_fft: int) -> np.ndarray:
@@ -44,7 +43,7 @@ def frame_signal(y: jnp.ndarray, n_fft: int, hop_length: int) -> jnp.ndarray:
     return y[:, idx]
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+@jit_program(static_argnums=(1, 2, 3))
 def stft_magnitude(y, n_fft: int, hop_length: int, win_length: int):
     """[B, T] float in [-1, 1] -> magnitude [B, 1 + n_fft//2, n_frames]."""
     frames = frame_signal(y, n_fft, hop_length)
@@ -87,7 +86,7 @@ class MelExtractor:
             sampling_rate, filter_length, n_mel_channels, mel_fmin, mel_fmax
         )
 
-        @jax.jit
+        @jit_program
         def _extract(y):
             mag = stft_magnitude(y, filter_length, hop_length, win_length)
             mel = jnp.einsum("mf,bft->bmt", jnp.asarray(self.mel_basis), mag)

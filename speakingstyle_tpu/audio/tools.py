@@ -7,7 +7,6 @@ jit-compiled overlap-add implementation, and its scipy wavfile usage
 scipy polyphase filtering (librosa is not a dependency of this framework).
 """
 
-import functools
 from fractions import Fraction
 
 import jax
@@ -17,9 +16,10 @@ import scipy.io.wavfile
 import scipy.signal
 
 from speakingstyle_tpu.audio.stft import frame_signal, hann_window
+from speakingstyle_tpu.parallel.registry import jit_program
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+@jit_program(static_argnums=(2, 3, 4))
 def istft(magnitude, phase, n_fft: int, hop_length: int, win_length: int):
     """Inverse STFT via windowed overlap-add.
 
@@ -55,7 +55,7 @@ def _stft_phase(y, n_fft, hop_length, win_length):
     return jnp.angle(spec)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+@jit_program(static_argnums=(1, 2, 3, 4))
 def griffin_lim(magnitudes, n_fft: int, hop_length: int, win_length: int, n_iters: int = 30):
     """Phase reconstruction from magnitude spectrogram [B, F, T] -> wav [B, T']."""
     key = jax.random.PRNGKey(0)

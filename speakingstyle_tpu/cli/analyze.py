@@ -156,10 +156,9 @@ def _restored_state(cfg, model, restore_step):
 
 
 def _predictions(cfg, split, restore_step, max_batches):
-    import jax
-
     from speakingstyle_tpu.data import BucketedBatcher, SpeechDataset
     from speakingstyle_tpu.models.factory import build_model
+    from speakingstyle_tpu.parallel.registry import jit_program
 
     model = build_model(cfg)
     state = _restored_state(cfg, model, restore_step)
@@ -169,7 +168,7 @@ def _predictions(cfg, split, restore_step, max_batches):
         ds, max_src=cfg.model.max_seq_len, max_mel=cfg.model.max_seq_len
     )
 
-    @jax.jit
+    @jit_program
     def fwd(params, batch_stats, arrays):
         return model.apply(
             {"params": params, "batch_stats": batch_stats},
@@ -226,14 +225,13 @@ def _style(cfg, split, restore_step, max_batches):
     # only the style branch is needed — apply the ReferenceEncoder
     # submodule directly on its params subtree (same construction as
     # models/fastspeech2.py), jitted, instead of the whole acoustic model
-    import jax
-
     from speakingstyle_tpu.models.factory import reference_encoder_from_config
     from speakingstyle_tpu.ops.masking import length_to_mask
+    from speakingstyle_tpu.parallel.registry import jit_program
 
     enc = reference_encoder_from_config(cfg)
 
-    @jax.jit
+    @jit_program
     def style_fwd(ref_params, mels, mel_lens):
         pad = length_to_mask(mel_lens, mels.shape[1])
         return enc.apply({"params": ref_params}, mels, pad, deterministic=True)

@@ -182,12 +182,10 @@ def main(args):
                 cfg.serve.style, ref_dir=args.ref_dir
             )
         ))
-    if cfg.train.obs.compilation_cache_dir:
-        # before the lattice precompile: a warm restart then serves its
-        # AOT programs out of the persistent cache instead of XLA
-        from speakingstyle_tpu.obs import enable_compilation_cache
-
-        enable_compilation_cache(cfg.train.obs.compilation_cache_dir)
+    # persistent compile-cache wiring moved into each engine's
+    # ProgramRegistry (parallel/registry.py), constructed before the
+    # lattice precompile — a warm restart then serves its AOT programs
+    # out of the persistent cache instead of XLA
     replicas = (
         args.replicas if args.replicas is not None
         else cfg.serve.fleet.replicas

@@ -78,12 +78,8 @@ def main(args):
     from speakingstyle_tpu.training.trainer import run_training
 
     cfg = config_from_args(args)
-    if cfg.train.obs.compilation_cache_dir:
-        # before any compile: warm restarts then skip the step compiles
-        # (cache hit/miss counts surface via the jaxmon bridge)
-        from speakingstyle_tpu.obs import enable_compilation_cache
-
-        enable_compilation_cache(cfg.train.obs.compilation_cache_dir)
+    # persistent compile-cache wiring moved into the ProgramRegistry that
+    # run_training constructs before its first compile (parallel/registry.py)
     par = cfg.train.parallel
     flags_given = args.data_parallel is not None or args.model_parallel is not None
     if not par.is_single() and not flags_given:

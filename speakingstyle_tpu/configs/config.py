@@ -318,9 +318,10 @@ class ShardingConfig:
 
 @dataclass(frozen=True)
 class ParallelConfig:
-    """Multichip mesh layout for the production trainer
-    (``parallel/mesh.py`` / ``parallel/partition.py`` — ARCHITECTURE.md
-    "Multichip training").
+    """Multichip mesh layout (``parallel/mesh.py`` /
+    ``parallel/partition.py`` — ARCHITECTURE.md "Multichip training").
+    Used twice: ``train.parallel`` shapes the trainer's mesh,
+    ``serve.parallel`` shapes one serving replica's mesh slice.
 
     ``mesh = [dp, tp]`` names the 2-D device mesh: batches shard over the
     ``data`` axis (dp-way), parameters shard over the ``model`` axis
@@ -346,24 +347,24 @@ class ParallelConfig:
     def __post_init__(self):
         if len(self.mesh) != 2:
             raise ValueError(
-                f"train.parallel.mesh must be [dp, tp], got {self.mesh}"
+                f"parallel.mesh must be [dp, tp], got {self.mesh}"
             )
         dp, tp = self.mesh
         if tp < 1:
-            raise ValueError(f"train.parallel.mesh tp must be >= 1, got {tp}")
+            raise ValueError(f"parallel.mesh tp must be >= 1, got {tp}")
         if dp < 1 and dp != -1:
             raise ValueError(
-                f"train.parallel.mesh dp must be >= 1 (or -1 for all "
+                f"parallel.mesh dp must be >= 1 (or -1 for all "
                 f"remaining devices), got {dp}"
             )
         if self.seq < 1:
-            raise ValueError(f"train.parallel.seq must be >= 1, got {self.seq}")
+            raise ValueError(f"parallel.seq must be >= 1, got {self.seq}")
         import re as _re
 
         for rule in self.partition_rules:
             if len(rule) != 2 or not all(isinstance(s, str) for s in rule):
                 raise ValueError(
-                    "train.parallel.partition_rules entries must be "
+                    "parallel.partition_rules entries must be "
                     f"[path_regex, axes] string pairs, got {rule!r}"
                 )
             pattern, axes = rule
@@ -371,12 +372,12 @@ class ParallelConfig:
                 _re.compile(pattern)
             except _re.error as e:
                 raise ValueError(
-                    f"train.parallel.partition_rules regex {pattern!r}: {e}"
+                    f"parallel.partition_rules regex {pattern!r}: {e}"
                 )
             for tok in axes.split(","):
                 if tok.strip().lower() not in ("", "none", "data", "model", "seq"):
                     raise ValueError(
-                        f"train.parallel.partition_rules axes token {tok!r} "
+                        f"parallel.partition_rules axes token {tok!r} "
                         "must be one of none|data|model|seq"
                     )
 
@@ -453,10 +454,11 @@ class ObsConfig:
     # rotation: shift events.jsonl -> .1 past this size, keep N rotated files
     events_max_bytes: int = 8_000_000
     events_keep: int = 3
-    # persistent XLA compilation cache directory ("" = disabled): wired at
-    # CLI startup for both `train` and `serve` (obs/jaxmon.py
-    # enable_compilation_cache), so warm restarts skip the AOT compiles —
-    # the jaxmon bridge counts cache hits vs requests into the registry
+    # persistent XLA compilation cache directory ("" = disabled): wired
+    # by the ProgramRegistry (parallel/registry.py) each consumer —
+    # trainer, serve replicas, style, bench — constructs, so every one
+    # of them gets the warm restart uniformly; the jaxmon bridge counts
+    # cache hits vs requests per-registry
     # (jax_persistent_cache_{hits,requests}_total) so /metrics
     # distinguishes a warm start from a cold one
     compilation_cache_dir: str = ""
@@ -926,6 +928,16 @@ class ServeConfig:
     style: StyleConfig = field(default_factory=StyleConfig)
     # canary-gated rolling model rollout (disabled by default)
     rollout: RolloutConfig = field(default_factory=RolloutConfig)
+    # mesh geometry of ONE replica (parallel/mesh.py resolve_mesh — the
+    # same resolution path as train.parallel): [1, 1] keeps the
+    # single-device engine byte-for-byte; [dp, tp] makes every replica a
+    # dp x tp mesh slice whose lattice programs compile with the batch
+    # axis sharded over ``data`` (buckets divisible by dp) and outputs
+    # replicated for host readback. Weights replicate unless
+    # partition_rules opt into tensor parallelism — replicated weights
+    # keep a mesh replica bit-identical to the 1x1 one from the same
+    # checkpoint (the cross-mesh serving contract).
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
 
     def __post_init__(self):
         for name in ("batch_buckets", "src_buckets", "mel_buckets"):

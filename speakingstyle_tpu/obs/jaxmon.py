@@ -19,9 +19,10 @@ subscribes to them:
     count is zero across a traffic window.
 
 ``enable_compilation_cache(dir)`` wires jax's persistent compile cache
-(the ``train.obs.compilation_cache_dir`` knob, applied at CLI startup by
-both ``train`` and ``serve``) so repeated runs skip the AOT compiles the
-cache already holds.
+(the ``train.obs.compilation_cache_dir`` knob, applied by each
+consumer's ``ProgramRegistry`` — ``parallel/registry.py`` — before its
+first compile) so repeated runs skip the AOT compiles the cache already
+holds.
 
 jax is imported lazily (on first install), so this module — like the
 rest of ``obs/`` — costs nothing to import in jax-free contexts
@@ -111,7 +112,12 @@ def enable_compilation_cache(cache_dir: str) -> str:
     """Point jax's persistent compilation cache at ``cache_dir`` (created
     if missing) and drop the min-size/min-time thresholds so every
     program — including the serving lattice's small buckets — is cached.
-    Returns the resolved directory. Call before the first compile."""
+    Returns the resolved directory. Safe to call after compiles have
+    already happened (a serve process restores its checkpoint — and
+    compiles — before the engine's ProgramRegistry exists): jax latches
+    its cache state on the first compile of the process, so a dir-less
+    latch must be reset or every later write is silently dropped while
+    the hit/request counters keep ticking."""
     import jax
 
     cache_dir = os.path.abspath(os.path.expanduser(cache_dir))
@@ -119,6 +125,19 @@ def enable_compilation_cache(cache_dir: str) -> str:
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    try:
+        from jax._src import compilation_cache as _cc
+
+        stale = _cc._cache_initialized and (
+            _cc._cache is None
+            or str(getattr(_cc._cache, "_path", "")) != cache_dir
+        )
+        if stale:
+            _cc.reset_cache()
+    except (ImportError, AttributeError):
+        # private API drift: the cache still works when enabled before
+        # the process's first compile, so don't take the process down
+        pass
     return cache_dir
 
 

@@ -1,8 +1,10 @@
-"""Parallelism: device meshes, shardings, and sequence-parallel attention."""
+"""Parallelism: device meshes, shardings, the partitioned-program
+registry, and sequence-parallel attention."""
 
 from speakingstyle_tpu.parallel.mesh import (
     BatchShardingError,
     batch_sharding,
+    dispatch_sharding,
     local_batch_size,
     make_mesh,
     make_seq_mesh,
@@ -10,13 +12,22 @@ from speakingstyle_tpu.parallel.mesh import (
     resolve_mesh,
     shard_batch,
 )
+from speakingstyle_tpu.parallel.registry import (
+    ProgramRegistry,
+    jit_program,
+    quiet_donation,
+)
 from speakingstyle_tpu.parallel.ring_attention import ring_attention, ring_self_attention
 
 __all__ = [
     "BatchShardingError",
+    "ProgramRegistry",
+    "jit_program",
+    "quiet_donation",
     "make_mesh",
     "make_seq_mesh",
     "batch_sharding",
+    "dispatch_sharding",
     "replicated",
     "resolve_mesh",
     "shard_batch",

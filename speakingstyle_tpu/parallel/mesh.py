@@ -54,6 +54,18 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def dispatch_sharding(mesh: Mesh, b: int) -> NamedSharding:
+    """Batch-leading sharding for one serving dispatch at batch size
+    ``b``: rows over ``data`` when they divide evenly, replicated
+    otherwise. The compile side (``in_shardings``/``out_shardings``) and
+    the dispatch side (``device_put``) must both call THIS function —
+    AOT executables hard-error on mismatched input shardings, which is
+    exactly the shape/sharding discipline serving wants."""
+    if b % mesh.shape["data"] == 0:
+        return NamedSharding(mesh, P("data"))
+    return NamedSharding(mesh, P())
+
+
 def shard_batch(batch, mesh: Mesh):
     """Device-put every array in a pytree with its batch axis over `data`."""
     sh = batch_sharding(mesh)
@@ -95,9 +107,10 @@ def local_batch_size(global_batch: int, mesh: Mesh) -> int:
 
 
 def resolve_mesh(parallel, devices: Optional[Sequence] = None) -> Optional[Mesh]:
-    """``train.parallel.*`` -> ``Mesh`` (or ``None`` for the single-chip path).
+    """``train.parallel.*`` / ``serve.parallel.*`` -> ``Mesh`` (or
+    ``None`` for the single-chip path).
 
-    ``mesh=[1,1]`` with ``seq=1`` returns ``None`` — the trainer then runs
+    ``mesh=[1,1]`` with ``seq=1`` returns ``None`` — the consumer then runs
     its unchanged single-chip path. ``dp=-1`` consumes every device not
     claimed by ``tp``. Asking for more devices than exist raises with the
     counts named (on the CPU proxy, set
@@ -110,14 +123,14 @@ def resolve_mesh(parallel, devices: Optional[Sequence] = None) -> Optional[Mesh]
     if dp == -1:
         if len(devices) % tp:
             raise ValueError(
-                f"train.parallel.mesh [-1, {tp}]: {len(devices)} devices "
+                f"parallel.mesh [-1, {tp}]: {len(devices)} devices "
                 f"not divisible by tp={tp}"
             )
         dp = len(devices) // tp
     n = dp * tp
     if n > len(devices):
         raise ValueError(
-            f"train.parallel.mesh {dp}x{tp} needs {n} devices but only "
+            f"parallel.mesh {dp}x{tp} needs {n} devices but only "
             f"{len(devices)} are visible (CPU proxy: set "
             f"XLA_FLAGS=--xla_force_host_platform_device_count={n})"
         )

@@ -110,6 +110,28 @@ def count_sharded(params, mesh: Mesh, rules=None) -> int:
     return sum(1 for s in sh.values() if s.spec != P())
 
 
+def variables_shardings(variables, mesh: Mesh, rules=None):
+    """Serve-side variables pytree ({"params": ..., "batch_stats": ...})
+    -> matching pytree of NamedShardings.
+
+    With ``rules`` (``serve.parallel.partition_rules`` through
+    ``parse_rule_overrides``) the params follow them over the mesh's
+    ``model`` axis; without rules EVERYTHING replicates — the serve
+    default, because replicated weights keep a mesh replica bit-identical
+    to the single-chip one (TP's row-parallel psum reorders float sums),
+    and bit-parity from one checkpoint across replica geometries is the
+    serving contract the cross-mesh tests pin down.
+    """
+    repl = NamedSharding(mesh, P())
+    out = {
+        k: jax.tree_util.tree_map(lambda _: repl, v)
+        for k, v in variables.items()
+    }
+    if rules and "params" in variables:
+        out["params"] = tp_shardings(variables["params"], mesh, rules)
+    return out
+
+
 def opt_state_shardings(opt_state, params, param_shardings, mesh: Mesh):
     """Shardings for an optax state given the parameter shardings.
 

@@ -1,0 +1,308 @@
+"""The partitioned-program registry: ONE place where XLA compilation happens.
+
+Before this module, four subsystems each re-invented the same compile
+ritual — ``jax.jit(fn, donate_argnums=...).lower(*shapes).compile()``
+under a donation-warning filter, a compile counter bump, a ProgramCard
+mint, per-program gauges, and (sometimes) persistent-compile-cache
+wiring: the mesh-sharded train step (training/trainer.py), the serve
+lattice (serving/engine.py), the style lattice (serving/style.py), and
+bench.py. ``ProgramRegistry`` extracts that ritual behind one guarded
+entry point:
+
+    (callable, mesh/sharding spec, shape bucket, donation spec)
+        -> compiled executable + ProgramCard + compile governance
+
+and jaxlint JL018 makes the guard structural: any ``jax.jit`` reference
+or ``.lower().compile()`` chain outside this file is a lint error, so
+the zero-steady-state-compiles invariant (JL008's concern) has exactly
+one choke point instead of a convention per subsystem.
+
+Governance the registry provides uniformly:
+
+  * **Cache-key semantics** — ``compile()`` keys on (program name, arg
+    shape/dtype signature, donation, sharding specs). A repeat request
+    returns the SAME ``Compiled`` object without recompiling; the
+    registry is the reason "did we already build this program?" has one
+    answer instead of four dicts.
+  * **Persistent compile cache** — pass ``cache_dir`` (or let a consumer
+    thread ``train.obs.compilation_cache_dir`` through) and the
+    registry wires jax's persistent cache before its first compile, so
+    every consumer — serve replicas, style, bench, the trainer — gets
+    the ~1.6 s warm restart, not just whichever CLI remembered to call
+    ``enable_compilation_cache``. Hits/requests land per-registry as
+    ``jax_persistent_cache_{hits,requests}_total`` in the registry's
+    metrics (the ``watch_compiles`` bus bridge).
+  * **Cards with shardings** — every compile mints a ProgramCard
+    (obs/cost.py) and stores a JSON-ready row that ALSO records the
+    mesh geometry and in/out NamedSharding specs the program was built
+    against; ``GET /debug/programs`` serves these rows directly, so a
+    mesh replica's programs show how they are partitioned.
+  * **Sharded AOT** — ``in_shardings``/``out_shardings`` pass straight
+    into ``jax.jit``, which is what lets a serve replica BE a mesh
+    slice: the engine compiles every lattice point with its batch axis
+    over the mesh's ``data`` axis and outputs replicated for host
+    readback (serving/engine.py).
+
+``jit_program`` is the sanctioned constructor for jit-on-first-call
+wrappers (the trainer's step functions, bench micro-timers, the audio
+DSP decorators): a thin alias of ``jax.jit`` that exists so JL018 can
+insist the spelling ``jax.jit`` appears nowhere else in the tree.
+"""
+
+import contextlib
+import functools
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "ProgramRegistry",
+    "jit_program",
+    "quiet_donation",
+]
+
+
+@contextlib.contextmanager
+def quiet_donation():
+    """CPU (and the int32 length vectors on any backend) cannot always
+    honor donation; jax warns per lowering. Donation through the
+    registry is best-effort by design — silence exactly that warning."""
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        yield
+
+
+def jit_program(fn: Optional[Callable] = None, **jit_kwargs):
+    """The sanctioned ``jax.jit`` constructor (usable as a decorator).
+
+    Compile-on-first-call wrappers are legitimate where the shape space
+    is unbounded or singular (training steps riding the bucket grid,
+    audio DSP over file-length signals); routing their construction
+    through the registry module keeps JL018's guarantee meaningful —
+    the only file that can spell ``jax.jit`` is this one.
+    """
+    import jax
+
+    if fn is None:
+        return functools.partial(jit_program, **jit_kwargs)
+    return jax.jit(fn, **jit_kwargs)
+
+
+def _signature(tree: Any) -> str:
+    """Stable hashable shape/dtype signature of an args pytree — the
+    shape-bucket component of a program's cache key. Works on
+    ShapeDtypeStructs, device/host arrays, and scalars alike."""
+    import jax
+
+    def leaf(x):
+        if hasattr(x, "shape") and hasattr(x, "dtype"):
+            return f"{tuple(x.shape)}:{x.dtype}"
+        return repr(x)
+
+    return repr(jax.tree_util.tree_map(leaf, tree))
+
+
+def _sharding_str(sh: Any) -> Optional[str]:
+    """Human-readable spelling of a (pytree of) NamedSharding(s) for the
+    card table; None passes through (single-device programs)."""
+    if sh is None:
+        return None
+    import jax
+
+    def leaf(s):
+        spec = getattr(s, "spec", None)
+        return str(spec) if spec is not None else str(s)
+
+    leaves = jax.tree_util.tree_leaves(
+        sh, is_leaf=lambda x: hasattr(x, "spec")
+    )
+    if not leaves:
+        return None
+    strs = [leaf(s) for s in leaves]
+    if len(set(strs)) == 1:
+        return strs[0]
+    return "(" + ", ".join(strs) + ")"
+
+
+def _mesh_of(sh: Any) -> Optional[str]:
+    """``"2x2"``-style geometry of the first NamedSharding in a spec
+    tree (all shardings of one program share the mesh)."""
+    if sh is None:
+        return None
+    import jax
+
+    for s in jax.tree_util.tree_leaves(sh, is_leaf=lambda x: hasattr(x, "spec")):
+        mesh = getattr(s, "mesh", None)
+        if mesh is not None:
+            return "x".join(str(mesh.shape[a]) for a in mesh.axis_names)
+    return None
+
+
+class ProgramRegistry:
+    """Compile governance for one consumer (an engine, a style service,
+    a trainer run, a bench process).
+
+    Each registry owns: its program + card tables, a compile counter in
+    the consumer's ``MetricsRegistry`` (``counter_name`` keeps the
+    historical per-subsystem names — ``serve_compiles_total``,
+    ``serve_style_compiles_total`` — working), the backend-compile bus
+    subscription (``watch_compiles``), and the persistent-cache hookup.
+    Sharing one metrics registry across consumers (the fleet does)
+    shares the bus counters; the program tables stay per-registry.
+    """
+
+    def __init__(
+        self,
+        metrics=None,
+        *,
+        cache_dir: Optional[str] = None,
+        counter_name: str = "program_registry_compiles_total",
+        prefix: str = "program",
+    ):
+        from speakingstyle_tpu.obs import MetricsRegistry, watch_compiles
+        from speakingstyle_tpu.obs.jaxmon import enable_compilation_cache
+
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        # backend-compile + persistent-cache events -> this registry's
+        # metrics (jax_backend_compiles_total,
+        # jax_persistent_cache_{hits,requests}_total)
+        watch_compiles(self.metrics)
+        self.cache_dir = (
+            enable_compilation_cache(cache_dir) if cache_dir else None
+        )
+        self.prefix = prefix
+        self._compiles = self.metrics.counter(
+            counter_name,
+            help="XLA programs compiled through this ProgramRegistry",
+        )
+        self._lock = threading.RLock()
+        self._programs: Dict[Tuple, Any] = {}
+        self._by_name: Dict[str, Any] = {}
+        self._cards: List[Dict] = []
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def compile_count(self) -> int:
+        return int(self._compiles.value)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._programs)
+
+    def get(self, name: str):
+        """Latest compiled executable registered under ``name`` (None if
+        never compiled) — the lookup consumers key their dispatch tables
+        from when they don't hold the executable themselves."""
+        with self._lock:
+            return self._by_name.get(name)
+
+    def programs(self) -> List[Dict]:
+        """The card table: one JSON-ready row per compiled program, in
+        compile order, each carrying the ProgramCard cost analysis plus
+        the mesh/sharding specs it was built against (the
+        ``GET /debug/programs`` payload)."""
+        with self._lock:
+            return [dict(row) for row in self._cards]
+
+    # -- the single compile entry point -------------------------------------
+
+    def compile(
+        self,
+        fn: Callable,
+        args: Tuple,
+        *,
+        name: str,
+        donate_argnums: Tuple[int, ...] = (),
+        static_argnums=None,
+        in_shardings=None,
+        out_shardings=None,
+        compiler_options: Optional[Dict] = None,
+        labels: Optional[Dict[str, str]] = None,
+    ):
+        """(callable, sharding spec, shape bucket, donation spec) ->
+        compiled executable, with the bookkeeping done.
+
+        ``args`` is the AOT argument tuple — ``jax.ShapeDtypeStruct``s
+        or concrete arrays (concrete works because lowering only reads
+        shape/dtype/sharding). The cache key is (name, args signature,
+        donation, sharding specs): a repeat call returns the stored
+        ``Compiled`` without recompiling, so "precompile twice" and
+        "two consumers ask for the same bucket" both cost one program.
+
+        ``fn`` may already be a jit wrapper (``jit_program`` output, the
+        trainer's case) — it is lowered as-is and the jit construction
+        kwargs must then be () / None.
+        """
+        import jax
+
+        key = (
+            name,
+            _signature(args),
+            repr(donate_argnums),
+            repr(static_argnums),
+            _sharding_str(in_shardings),
+            _sharding_str(out_shardings),
+        )
+        with self._lock:
+            exe = self._programs.get(key)
+            if exe is not None:
+                return exe
+            if hasattr(fn, "lower") and not isinstance(fn, type):
+                # already a jit wrapper — lower it directly
+                jitted = fn
+            else:
+                kwargs: Dict[str, Any] = {"donate_argnums": donate_argnums}
+                if static_argnums is not None:
+                    kwargs["static_argnums"] = static_argnums
+                if in_shardings is not None:
+                    kwargs["in_shardings"] = in_shardings
+                if out_shardings is not None:
+                    kwargs["out_shardings"] = out_shardings
+                jitted = jax.jit(fn, **kwargs)
+            with quiet_donation():
+                lowered = jitted.lower(*args)
+                exe = (
+                    lowered.compile(compiler_options=compiler_options)
+                    if compiler_options
+                    else lowered.compile()
+                )
+            self._compiles.inc()
+            self._programs[key] = exe
+            self._by_name[name] = exe
+            self._record(exe, name, donate_argnums, in_shardings,
+                         out_shardings, labels)
+        return exe
+
+    def _record(self, exe, name, donate, in_sh, out_sh, labels) -> None:
+        """Mint the ProgramCard, publish gauges, append the card row.
+        Caller holds the lock. Card minting only reads compiler metadata
+        — it can never itself compile."""
+        from speakingstyle_tpu.obs.cost import (
+            ProgramCard,
+            publish_program_gauges,
+        )
+
+        card = ProgramCard.from_compiled(exe, name=name)
+        publish_program_gauges(
+            self.metrics, card, self.prefix, labels=labels or {}
+        )
+        row = card.as_dict()
+        row["mesh"] = _mesh_of(in_sh) or _mesh_of(out_sh)
+        row["in_shardings"] = _sharding_str(in_sh)
+        row["out_shardings"] = _sharding_str(out_sh)
+        row["donate_argnums"] = list(donate)
+        if labels:
+            row.update({f"label_{k}": v for k, v in labels.items()})
+        self._cards.append(row)
+
+    def card(self, name: str) -> Optional[Dict]:
+        """The most recent card row registered under ``name``."""
+        with self._lock:
+            for row in reversed(self._cards):
+                if row.get("name") == name:
+                    return dict(row)
+        return None

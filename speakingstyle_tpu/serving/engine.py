@@ -1,12 +1,29 @@
 """AOT-precompiled synthesis engine: padded text batches -> mel -> wav.
 
 The serving counterpart of the training step: at construction the engine
-``jax.jit(...).lower(...).compile()``s the free-running acoustic model
-(FastSpeech2 + length-regulator free-run) for every lattice point and the
-HiFi-GAN generator for every ``(batch, T_mel)`` pair, with the padded
-request buffers donated. Steady-state dispatch then only ever calls the
-stored ``Compiled`` executables — which hard-error on a shape mismatch
-rather than retrace — so the serve loop structurally cannot compile.
+AOT-compiles — through its ``ProgramRegistry`` (parallel/registry.py),
+the tree's single sanctioned compile entry point — the free-running
+acoustic model (FastSpeech2 + length-regulator free-run) for every
+lattice point and the HiFi-GAN generator for every ``(batch, T_mel)``
+pair, with the padded request buffers donated. Steady-state dispatch
+then only ever calls the stored ``Compiled`` executables — which
+hard-error on a shape mismatch rather than retrace — so the serve loop
+structurally cannot compile.
+
+A replica can BE a mesh slice: ``serve.parallel.mesh`` resolves through
+the same ``resolve_mesh`` path as training, every lattice point compiles
+with explicit NamedSharding in/out specs (batch rows over the mesh's
+``data`` axis when they divide evenly, replicated otherwise), and the
+weights replicate by default (tensor parallelism is opt-in via
+``serve.parallel.partition_rules``). The parity contract across replica
+geometries, from ONE unchanged checkpoint: any bucket whose compute
+replicates — every non-divisible batch bucket, so in particular every
+single-request dispatch, and all buckets on a dp=1 slice — serves
+BIT-identically to the 1x1 engine; a data-sharded coalesced bucket
+agrees to float32 ULP (XLA codegen for b/dp-row shards vs one b-row
+program — the same numerics trade DP training makes). The FleetRouter,
+autoscaler, rollout, and streaming layers only see the engine
+interface, so they work over mesh replicas unchanged.
 
 The acoustic programs consume precomputed FiLM ``(gamma, beta)`` vectors
 rather than a raw reference mel: the reference encoder lives in the
@@ -22,8 +39,9 @@ forces a larger synthesis bucket.
 Two compile counters back that claim up, both living in the engine's
 metrics registry (``speakingstyle_tpu/obs``):
 
-  * ``serve_compiles_total`` — incremented by the engine itself around
-    each ``.compile()`` (``engine.compile_count`` is a view of it);
+  * ``serve_compiles_total`` — incremented by the engine's
+    ProgramRegistry around each compile it performs
+    (``engine.compile_count`` is a view of it);
   * ``jax_backend_compiles_total`` — fed by the generalized
     ``jax.monitoring`` bridge (obs/jaxmon.py) from the backend's own
     ``/jax/core/compile/backend_compile_duration`` event, which catches
@@ -57,12 +75,14 @@ import numpy as np
 
 from speakingstyle_tpu.configs.config import Config
 from speakingstyle_tpu.faults import FaultPlan
-from speakingstyle_tpu.obs import CompileMonitor, MetricsRegistry, watch_compiles
-from speakingstyle_tpu.obs.cost import (
-    FLOPS_PER_SEC_BUCKETS,
-    ProgramCard,
-    publish_program_gauges,
+from speakingstyle_tpu.obs import CompileMonitor, MetricsRegistry
+from speakingstyle_tpu.obs.cost import FLOPS_PER_SEC_BUCKETS
+from speakingstyle_tpu.parallel.mesh import dispatch_sharding, resolve_mesh
+from speakingstyle_tpu.parallel.partition import (
+    parse_rule_overrides,
+    variables_shardings,
 )
+from speakingstyle_tpu.parallel.registry import ProgramRegistry
 from speakingstyle_tpu.serving.lattice import Bucket, BucketLattice, RequestTooLarge
 from speakingstyle_tpu.serving.pool import BufferPool
 from speakingstyle_tpu.serving.resilience import InjectedFault
@@ -139,20 +159,6 @@ class SynthesisResult:
     style_degraded: bool = False
 
 
-@contextlib.contextmanager
-def _quiet_donation():
-    """CPU (and the int32 length vectors on any backend) cannot always
-    honor donation; jax warns per lowering. The donation here is
-    best-effort by design — silence exactly that warning."""
-    import warnings
-
-    with warnings.catch_warnings():
-        warnings.filterwarnings(
-            "ignore", message="Some donated buffers were not usable"
-        )
-        yield
-
-
 def _fill_control(rows: List[Control], out: np.ndarray) -> np.ndarray:
     """Per-request controls -> the padded [B, L] float32 array ``out``
     (pool-leased, pre-filled with the neutral 1.0; padding rows/positions
@@ -200,6 +206,7 @@ class SynthesisEngine:
         # plan (cli/serve.py threads one shared plan fleet-wide);
         # consumes vocoder_raise@N (N = Nth vocode_window call on this
         # engine, 1-based). None = no injection.
+        program_registry: Optional[ProgramRegistry] = None,
     ):
         from speakingstyle_tpu.models.factory import build_model
 
@@ -215,6 +222,34 @@ class SynthesisEngine:
         )
         self.variables = variables
         self.vocoder = vocoder
+        # a serving replica IS a mesh slice: ``serve.parallel`` resolves
+        # through the same resolve_mesh path as training (None = the
+        # unchanged single-chip path). Weights replicate by default —
+        # replicated weights keep a mesh replica bit-identical to the
+        # 1x1 one from the same checkpoint (TP's row-parallel psum
+        # reorders float sums); TP is opt-in via
+        # serve.parallel.partition_rules.
+        self.mesh = resolve_mesh(cfg.serve.parallel)
+        self._var_shardings = None
+        if self.mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            rules = (
+                parse_rule_overrides(cfg.serve.parallel.partition_rules)
+                if cfg.serve.parallel.partition_rules else None
+            )
+            self._var_shardings = variables_shardings(
+                variables, self.mesh, rules
+            )
+            self.variables = jax.tree_util.tree_map(
+                jax.device_put, variables, self._var_shardings
+            )
+            if vocoder is not None:
+                gen, params = vocoder
+                self.vocoder = (gen, jax.device_put(
+                    params, NamedSharding(self.mesh, PartitionSpec())
+                ))
         pp = cfg.preprocess.preprocessing
         self.n_mels = pp.mel.n_mel_channels
         self.max_wav_value = pp.audio.max_wav_value
@@ -224,10 +259,23 @@ class SynthesisEngine:
         self._energy_axis = (
             "src" if pp.energy.feature == "phoneme_level" else "mel"
         )
-        # per-engine registry (pass one to share); the backend compile
-        # bridge feeds jax_backend_compiles_total into it
+        # per-engine registry (pass one to share); the program registry
+        # below subscribes it to the backend compile bridge
+        # (jax_backend_compiles_total + persistent-cache counters)
         self.registry = registry if registry is not None else MetricsRegistry()
-        watch_compiles(self.registry)
+        # ALL engine compiles flow through this one guarded entry point
+        # (parallel/registry.py): compile counting, ProgramCards with
+        # sharding specs, per-program gauges, and the persistent-cache
+        # hookup happen there, not here
+        self.program_registry = (
+            program_registry if program_registry is not None
+            else ProgramRegistry(
+                self.registry,
+                cache_dir=cfg.train.obs.compilation_cache_dir or None,
+                counter_name="serve_compiles_total",
+                prefix="serve",
+            )
+        )
         # the style subsystem: pass one to share (the fleet router does —
         # one embedding cache + one encoder lattice across all replicas);
         # absent, the engine owns a private service over the same
@@ -242,10 +290,6 @@ class SynthesisEngine:
             )
         else:
             self.style = None
-        self._compiles = self.registry.counter(
-            "serve_compiles_total",
-            help="XLA programs compiled by the engine (precompile + misses)",
-        )
         self._dispatches = self.registry.counter(
             "serve_dispatches_total", help="padded device dispatches executed"
         )
@@ -254,12 +298,11 @@ class SynthesisEngine:
         )
         self._acoustic: Dict[Bucket, object] = {}
         self._vocoder_exe: Dict[Tuple[int, int], object] = {}
-        # one ProgramCard per compiled executable, minted at compile time
-        # (cost/memory analysis only reads compiler metadata — building a
-        # card can never itself compile, so the zero-steady-state-compiles
-        # invariant is untouched)
-        self._acoustic_cards: Dict[Bucket, ProgramCard] = {}
-        self._vocoder_cards: Dict[Tuple[int, int], ProgramCard] = {}
+        # per-program FLOPs cached out of the registry's card table at
+        # compile time, so the dispatch hot path never takes the
+        # registry lock for its achieved-FLOP/s arithmetic
+        self._acoustic_flops: Dict[Bucket, Optional[float]] = {}
+        self._vocoder_flops: Dict[Tuple[int, int], Optional[float]] = {}
         self._lock = threading.Lock()  # compile-on-miss exclusion
         self.fault_plan = fault_plan
         # vocoder_raise@N indexes this 1-based call counter; an int (not
@@ -295,9 +338,9 @@ class SynthesisEngine:
 
     @property
     def compile_count(self) -> int:
-        """Engine-performed compiles — a view of the registry counter
-        (no parallel bookkeeping)."""
-        return int(self._compiles.value)
+        """Engine-performed compiles — a view of the program registry's
+        counter (no parallel bookkeeping)."""
+        return self.program_registry.compile_count
 
     @property
     def dispatch_count(self) -> int:
@@ -318,24 +361,21 @@ class SynthesisEngine:
         return len(self._acoustic) >= len(self.lattice)
 
     def programs(self) -> List[Dict]:
-        """One JSON-ready ProgramCard dict per compiled executable —
-        acoustic programs in lattice order, then vocoder programs (the
-        ``GET /debug/programs`` payload)."""
-        out = []
-        for bucket in sorted(self._acoustic_cards, key=lambda b: b.volume):
-            out.append(self._acoustic_cards[bucket].as_dict())
-        for key in sorted(self._vocoder_cards):
-            out.append(self._vocoder_cards[key].as_dict())
-        return out
+        """The program registry's card table, straight through: one
+        JSON-ready row per compiled executable in compile order, each
+        carrying the cost analysis PLUS the mesh geometry and in/out
+        sharding specs it was built against (the ``GET /debug/programs``
+        payload — a mesh replica's programs show their partitioning)."""
+        return self.program_registry.programs()
 
     def _dispatch_flops(self, bucket: Bucket) -> Optional[float]:
         """Total card FLOPs one dispatch at ``bucket`` executes (acoustic
         + vocoder when present); None when the backend reported none."""
-        cards = [self._acoustic_cards.get(bucket)]
+        flops = [self._acoustic_flops.get(bucket)]
         if self.vocoder is not None:
-            cards.append(self._vocoder_cards.get((bucket.b, bucket.t_mel)))
-        flops = [c.flops for c in cards if c is not None and c.flops]
-        return sum(flops) if flops else None
+            flops.append(self._vocoder_flops.get((bucket.b, bucket.t_mel)))
+        real = [f for f in flops if f]
+        return sum(real) if real else None
 
     # -- compilation --------------------------------------------------------
 
@@ -408,18 +448,29 @@ class SynthesisEngine:
             s((b, l), jnp.float32),                    # d_control
         )
         donate = tuple(range(1, 9)) if self.cfg.serve.donate_buffers else ()
-        jitted = jax.jit(self._acoustic_fn(t), donate_argnums=donate)
-        with _quiet_donation():
-            exe = jitted.lower(*args).compile()
-        self._acoustic[bucket] = exe
-        self._compiles.inc()
+        in_sh = out_sh = None
+        if self.mesh is not None:
+            # batch-leading args shard rows over ``data`` (replicated
+            # when b doesn't divide); every output keeps its leading
+            # batch axis, so the same spec carries out. _transfer uses
+            # the identical rule — the compiled-in shardings and the
+            # dispatch-time device_puts must agree.
+            bsh = dispatch_sharding(self.mesh, b)
+            in_sh = (self._var_shardings,) + (bsh,) * 8
+            out_sh = bsh
         label = bucket_label(bucket)
-        card = ProgramCard.from_compiled(exe, name=f"acoustic:{label}")
-        self._acoustic_cards[bucket] = card
-        publish_program_gauges(
-            self.registry, card, "serve",
+        name = f"acoustic:{label}"
+        self._acoustic[bucket] = self.program_registry.compile(
+            self._acoustic_fn(t), args,
+            name=name,
+            donate_argnums=donate,
+            in_shardings=in_sh,
+            out_shardings=out_sh,
             labels={"kind": "acoustic", "bucket": label},
         )
+        self._acoustic_flops[bucket] = (
+            self.program_registry.card(name) or {}
+        ).get("flops")
 
     def _compile_vocoder(self, b: int, t: int):
         import jax
@@ -433,19 +484,29 @@ class SynthesisEngine:
             return gen.vocode(p, mels)
 
         donate = (1,) if self.cfg.serve.donate_buffers else ()
-        jitted = jax.jit(fn, donate_argnums=donate)
-        with _quiet_donation():
-            exe = jitted.lower(
-                params, jax.ShapeDtypeStruct((b, t, self.n_mels), jnp.float32)
-            ).compile()
-        self._vocoder_exe[(b, t)] = exe
-        self._compiles.inc()
-        card = ProgramCard.from_compiled(exe, name=f"vocoder:b{b}.m{t}")
-        self._vocoder_cards[(b, t)] = card
-        publish_program_gauges(
-            self.registry, card, "serve",
+        in_sh = out_sh = None
+        if self.mesh is not None:
+            # mel input sharding matches the acoustic program's output
+            # sharding at this batch size, so mel_out flows into the
+            # vocoder without a resharding hop
+            bsh = dispatch_sharding(self.mesh, b)
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            in_sh = (NamedSharding(self.mesh, PartitionSpec()), bsh)
+            out_sh = bsh
+        name = f"vocoder:b{b}.m{t}"
+        self._vocoder_exe[(b, t)] = self.program_registry.compile(
+            fn,
+            (params, jax.ShapeDtypeStruct((b, t, self.n_mels), jnp.float32)),
+            name=name,
+            donate_argnums=donate,
+            in_shardings=in_sh,
+            out_shardings=out_sh,
             labels={"kind": "vocoder", "bucket": f"b{b}.m{t}"},
         )
+        self._vocoder_flops[(b, t)] = (
+            self.program_registry.card(name) or {}
+        ).get("flops")
 
     # -- streaming window vocode --------------------------------------------
 
@@ -591,13 +652,21 @@ class SynthesisEngine:
     # -- dispatch -----------------------------------------------------------
 
     def _transfer(self, arrays: Dict[str, np.ndarray]) -> Dict:
-        """Host->device with the DevicePrefetcher retry discipline."""
+        """Host->device with the DevicePrefetcher retry discipline. On a
+        mesh replica every batch-leading array lands with the exact
+        sharding its program was compiled against (dispatch_sharding —
+        same divisibility rule as the compile side)."""
         import jax
 
         serve = self.cfg.serve
 
         def put():
-            return {k: jax.device_put(v) for k, v in arrays.items()}
+            if self.mesh is None:
+                return {k: jax.device_put(v) for k, v in arrays.items()}
+            return {
+                k: jax.device_put(v, dispatch_sharding(self.mesh, v.shape[0]))
+                for k, v in arrays.items()
+            }
 
         if not serve.transfer_retries:
             return put()

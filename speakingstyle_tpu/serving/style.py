@@ -42,7 +42,6 @@ fused path it replaces, where the same reference was padded to whatever
 ``T_mel`` bucket the co-batched text happened to need.
 """
 
-import contextlib
 import hashlib
 import threading
 import time
@@ -55,7 +54,8 @@ import numpy as np
 from speakingstyle_tpu.configs.config import Config
 from speakingstyle_tpu.faults import FaultPlan
 from speakingstyle_tpu.obs import MetricsRegistry
-from speakingstyle_tpu.obs.cost import ProgramCard, publish_program_gauges
+from speakingstyle_tpu.parallel.mesh import dispatch_sharding, resolve_mesh
+from speakingstyle_tpu.parallel.registry import ProgramRegistry
 from speakingstyle_tpu.serving.lattice import StyleLattice
 from speakingstyle_tpu.serving.pool import BufferPool
 from speakingstyle_tpu.serving.resilience import InjectedFault
@@ -116,19 +116,6 @@ class StyleVectors:
         }
 
 
-@contextlib.contextmanager
-def _quiet_donation():
-    """CPU cannot always honor donation; jax warns per lowering. The
-    donation here is best-effort by design — silence exactly that."""
-    import warnings
-
-    with warnings.catch_warnings():
-        warnings.filterwarnings(
-            "ignore", message="Some donated buffers were not usable"
-        )
-        yield
-
-
 class StyleService:
     """AOT reference-encoder programs + content-addressed (gamma, beta) cache.
 
@@ -148,6 +135,7 @@ class StyleService:
         # plan (cli/serve.py threads one shared plan fleet-wide);
         # consumes style_encode_error@N (N = Nth encoder dispatch
         # attempt on this service, 1-based). None = no injection.
+        program_registry: Optional[ProgramRegistry] = None,
     ):
         from speakingstyle_tpu.models.factory import (
             reference_encoder_from_config,
@@ -166,6 +154,21 @@ class StyleService:
         self.cfg = cfg
         self.lattice = StyleLattice.from_config(cfg.serve)
         self.variables = {"params": params}
+        # the service rides the same mesh slice as its engine
+        # (serve.parallel); encoder weights always replicate — the
+        # style path is tiny and bit-parity across replica geometries
+        # is the serving contract
+        self.mesh = resolve_mesh(cfg.serve.parallel)
+        if self.mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._repl_sharding = NamedSharding(self.mesh, PartitionSpec())
+            self.variables = jax.device_put(
+                self.variables, self._repl_sharding
+            )
+        else:
+            self._repl_sharding = None
         # position tables are build-time constants, sized to this
         # service's own ref buckets (checkpoint-safe, like the engine's)
         self.module = reference_encoder_from_config(
@@ -195,9 +198,17 @@ class StyleService:
             "serve_style_cache_entries",
             help="styles currently resident in the embedding cache",
         )
-        self._compiles = self.registry.counter(
-            "serve_style_compiles_total",
-            help="reference-encoder programs compiled (precompile + misses)",
+        # all encoder compiles flow through the one guarded entry point
+        # (parallel/registry.py); the historical counter name keeps
+        # serve_style_compiles_total working
+        self.program_registry = (
+            program_registry if program_registry is not None
+            else ProgramRegistry(
+                self.registry,
+                cache_dir=cfg.train.obs.compilation_cache_dir or None,
+                counter_name="serve_style_compiles_total",
+                prefix="serve",
+            )
         )
         self._dispatches = self.registry.counter(
             "serve_style_dispatches_total",
@@ -215,7 +226,6 @@ class StyleService:
         self._seq = 0
         self._cache_lock = threading.Lock()
         self._exe: Dict[Tuple[int, int], object] = {}
-        self._cards: Dict[Tuple[int, int], ProgramCard] = {}
         self._compile_lock = threading.Lock()
         # encoder-dispatch staging rides the same pooled-buffer
         # discipline as the synthesis engine (serving/pool.py)
@@ -242,7 +252,7 @@ class StyleService:
 
     @property
     def compile_count(self) -> int:
-        return int(self._compiles.value)
+        return self.program_registry.compile_count
 
     @property
     def dispatch_count(self) -> int:
@@ -257,12 +267,10 @@ class StyleService:
             return self._encode_attempts
 
     def programs(self) -> List[Dict]:
-        """JSON-ready ProgramCards, smallest point first (joins the
-        engine's cards in ``GET /debug/programs``)."""
-        return [
-            self._cards[p].as_dict()
-            for p in sorted(self._cards, key=lambda p: p[0] * p[1])
-        ]
+        """The style registry's card table, straight through — one
+        JSON-ready row per encoder program with its sharding specs
+        (joins the engine's rows in ``GET /debug/programs``)."""
+        return self.program_registry.programs()
 
     def _encode_fn(self, r: int):
         from speakingstyle_tpu.ops.masking import length_to_mask
@@ -289,20 +297,26 @@ class StyleService:
         b, r = point
         s = jax.ShapeDtypeStruct
         donate = (1, 2) if self.cfg.serve.donate_buffers else ()
-        jitted = jax.jit(self._encode_fn(r), donate_argnums=donate)
-        with _quiet_donation():
-            exe = jitted.lower(
+        in_sh = out_sh = None
+        if self.mesh is not None:
+            # same divisibility rule as the engine: batch rows over
+            # ``data`` when they divide, replicated otherwise — and the
+            # device_put in _encode_chunk matches it
+            bsh = dispatch_sharding(self.mesh, b)
+            in_sh = (self._repl_sharding, bsh, bsh)
+            out_sh = bsh
+        label = style_bucket_label(point)
+        self._exe[point] = self.program_registry.compile(
+            self._encode_fn(r),
+            (
                 self.variables,
                 s((b, r, self.n_mels), jnp.float32),
                 s((b,), jnp.int32),
-            ).compile()
-        self._exe[point] = exe
-        self._compiles.inc()
-        label = style_bucket_label(point)
-        card = ProgramCard.from_compiled(exe, name=f"style:{label}")
-        self._cards[point] = card
-        publish_program_gauges(
-            self.registry, card, "serve",
+            ),
+            name=f"style:{label}",
+            donate_argnums=donate,
+            in_shardings=in_sh,
+            out_shardings=out_sh,
             labels={"kind": "style", "bucket": label},
         )
 
@@ -504,8 +518,16 @@ class StyleService:
             for i, mel in enumerate(mels):
                 padded[i, : mel.shape[0]] = mel
                 lens[i] = mel.shape[0]
+            if self.mesh is None:
+                dev_m, dev_l = jax.device_put(padded), jax.device_put(lens)
+            else:
+                # must match the compiled-in shardings (same rule as
+                # _compile_point): AOT exes reject mismatched inputs
+                bsh = dispatch_sharding(self.mesh, b)
+                dev_m = jax.device_put(padded, bsh)
+                dev_l = jax.device_put(lens, bsh)
             gammas_dev, betas_dev = self._exe[point](
-                self.variables, jax.device_put(padded), jax.device_put(lens)
+                self.variables, dev_m, dev_l
             )
             # read back INSIDE the timed region: the histogram must
             # measure device execution, not async enqueue (the JL010

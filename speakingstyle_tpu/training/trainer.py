@@ -21,6 +21,7 @@ from speakingstyle_tpu import obs
 from speakingstyle_tpu.analysis import contracts
 from speakingstyle_tpu.configs.config import Config
 from speakingstyle_tpu.models.loss import fastspeech2_loss
+from speakingstyle_tpu.parallel.registry import ProgramRegistry, jit_program
 from speakingstyle_tpu.training import faults, resilience
 from speakingstyle_tpu.training.state import TrainState
 
@@ -32,15 +33,25 @@ def public_losses(losses: Dict) -> Dict:
     return {k: v for k, v in losses.items() if k not in _INTERNAL_LOSS_KEYS}
 
 
-def build_train_step_card(train_step, state, arrays, rng):
+def build_train_step_card(train_step, state, arrays, rng,
+                          program_registry: Optional[ProgramRegistry] = None):
     """ProgramCard (obs/cost.py) for the jitted train step at the given
     batch geometry: XLA's own FLOP/bytes/memory accounting of the step
-    program. ``.lower().compile()`` does not share jax's in-memory jit
-    cache, so this costs ONE extra compile of the step program — a
-    persistent-cache hit when ``train.obs.compilation_cache_dir`` is set.
+    program. The AOT compile goes through the ProgramRegistry (the
+    tree's one compile entry point) and does not share jax's in-memory
+    jit cache, so this costs ONE extra compile of the step program — a
+    persistent-cache hit when ``train.obs.compilation_cache_dir`` is set
+    (the registry wires the cache itself).
     Returns None (with a warning) rather than ever failing the run."""
+    registry = (
+        program_registry if program_registry is not None
+        else ProgramRegistry(counter_name="train_compiles_total",
+                             prefix="train")
+    )
     try:
-        compiled = train_step.lower(state, arrays, rng).compile()
+        compiled = registry.compile(
+            train_step, (state, arrays, rng), name="train_step"
+        )
     except Exception as e:
         print(
             "warning: train-step program card unavailable "
@@ -147,12 +158,12 @@ def make_train_step(model, tx, cfg: Config, mesh=None, state_shardings=None):
         return new_state, losses
 
     if mesh is None:
-        return jax.jit(step_fn, donate_argnums=(0,))
+        return jit_program(step_fn, donate_argnums=(0,))
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P("data"))
     if state_shardings is None:
         state_shardings = repl  # pure DP: state fully replicated
-    return jax.jit(
+    return jit_program(
         step_fn,
         in_shardings=(state_shardings, data, repl),
         out_shardings=(state_shardings, repl),
@@ -185,12 +196,12 @@ def make_eval_step(model, cfg: Config, mesh=None, state_shardings=None):
         )
 
     if mesh is None:
-        return jax.jit(eval_fn)
+        return jit_program(eval_fn)
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P("data"))
     if state_shardings is None:
         state_shardings = repl
-    return jax.jit(
+    return jit_program(
         eval_fn, in_shardings=(state_shardings, data), out_shardings=repl
     )
 
@@ -220,7 +231,7 @@ def make_predict_step(model, cfg: Config, mesh=None):
             deterministic=True,
         )
 
-    return jax.jit(predict_fn, static_argnums=(2,))
+    return jit_program(predict_fn, static_argnums=(2,))
 
 
 def evaluate(eval_step, state, batches: Iterator) -> Dict[str, float]:
@@ -323,6 +334,16 @@ def run_training(
         local_batch_size(cfg.train.optimizer.batch_size, mesh)
 
     registry = registry if registry is not None else obs.get_registry()
+    # one compile entry point for the run: wires the persistent compile
+    # cache (train.obs.compilation_cache_dir) BEFORE the first jit-on-call
+    # compile and counts/publishes per-program cards for anything compiled
+    # through it (the train-step ProgramCard below)
+    program_registry = ProgramRegistry(
+        registry,
+        cache_dir=cfg.train.obs.compilation_cache_dir or None,
+        counter_name="train_compiles_total",
+        prefix="train",
+    )
     step_hist = registry.histogram(
         "train_step_seconds",
         help="per-step wall time excluding data wait (host dispatch; "
@@ -560,7 +581,8 @@ def run_training(
                 if card_pending:
                     card_pending = False
                     program_card = build_train_step_card(
-                        train_step, state, arrays, step_rng
+                        train_step, state, arrays, step_rng,
+                        program_registry=program_registry,
                     )
                     if program_card is not None and logger:
                         logger.event("program_card", **program_card.as_dict())

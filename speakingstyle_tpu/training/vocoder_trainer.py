@@ -29,6 +29,7 @@ from speakingstyle_tpu.models.hifigan_disc import (
     feature_matching_loss,
     generator_adversarial_loss,
 )
+from speakingstyle_tpu.parallel.registry import jit_program
 
 
 class VocoderHParams(NamedTuple):
@@ -221,10 +222,10 @@ def make_vocoder_train_step(cfg: Config, hp: VocoderHParams, gen, mpd, msd,
         return new_state, metrics
 
     if mesh is None:
-        return jax.jit(step_fn, donate_argnums=(0,))
+        return jit_program(step_fn, donate_argnums=(0,))
     repl = NamedSharding(mesh, P())
     data = NamedSharding(mesh, P("data"))
-    return jax.jit(
+    return jit_program(
         step_fn,
         in_shardings=(repl, data, data),
         out_shardings=(repl, repl),

@@ -1034,6 +1034,96 @@ def test_jl017_negative_outside_training_serving():
 
 
 # ---------------------------------------------------------------------------
+# JL018 — XLA compilation outside the program registry
+# ---------------------------------------------------------------------------
+
+
+def test_jl018_positive_jit_call_and_decorator():
+    found = _codes("""
+        import functools
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x
+
+        @functools.partial(jax.jit, static_argnums=(1,))
+        def g(x, n):
+            return x * n
+
+        h = jax.jit(lambda y: y)
+    """, path="speakingstyle_tpu/serving/fake.py")
+    assert "JL018" in found
+
+
+def test_jl018_positive_from_import_and_aot_chain():
+    assert "JL018" in _codes("""
+        from jax import jit
+
+        def build(fn, args):
+            return fn.lower(*args).compile()
+    """, path="speakingstyle_tpu/training/fake.py")
+    assert "JL018" in _codes("""
+        import jax
+
+        def build(fn):
+            return jax.jit(fn)
+    """, path="bench.py")
+
+
+def test_jl018_negative_registry_and_out_of_scope():
+    src = """
+        import jax
+
+        def compile_it(fn):
+            return jax.jit(fn)
+    """
+    # the one sanctioned file
+    assert "JL018" not in _codes(
+        src, path="speakingstyle_tpu/parallel/registry.py"
+    )
+    # tests/scripts are fixtures, not production programs
+    assert "JL018" not in _codes(src, path="tests/fake.py")
+    assert "JL018" not in _codes(src, path="scripts/fake.py")
+
+
+def test_jl018_negative_precompile_exempt():
+    assert "JL018" not in _codes("""
+        import jax
+
+        def precompile(fns):
+            return [jax.jit(f) for f in fns]
+    """, path="speakingstyle_tpu/serving/fake.py")
+
+
+def test_jl018_jit_program_is_clean_and_recognized_as_tracing():
+    # the sanctioned spelling passes JL018 AND keeps the dataflow rules
+    # awake: jit_program-wrapped functions are traced contexts (JL001)
+    found = _codes("""
+        from speakingstyle_tpu.parallel.registry import jit_program
+
+        @jit_program
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+    """, path="speakingstyle_tpu/serving/fake.py")
+    assert "JL018" not in found
+    assert "JL001" in found
+
+
+def test_jl018_tree_baseline_is_zero():
+    """The structural invariant the registry migration bought: no file
+    in the enforced tree spells jax.jit / .lower().compile() anymore,
+    and none may regress into it (JL018 has NO baseline allowance)."""
+    findings = [f for f in linter.lint_paths() if f.rule == "JL018"]
+    assert findings == [], (
+        "JL018 must stay at zero tree findings — route compiles through "
+        f"ProgramRegistry/jit_program: {[f.fingerprint for f in findings]}"
+    )
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -1165,6 +1255,9 @@ def test_every_rule_is_non_vacuous():
     # JL017 is absent because the one in-scope artifact writer (the
     # checkpoint manifest in training/checkpoint.py) already publishes
     # via temp + fsync + os.replace — the idiom the rule enforces.
+    # JL018 is absent BY CONSTRUCTION: the registry migration removed
+    # every jax.jit / .lower().compile() spelling from the enforced
+    # tree, and test_jl018_tree_baseline_is_zero pins it at zero.
     for code in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
                  "JL007", "JL008"):
         assert code in fired, f"{code} never fires on the real tree"
@@ -1209,6 +1302,7 @@ def test_cli_check_exits_zero_on_repo():
     ("JL017", "def save(ckpt_path, blob):\n"
               "    with open(ckpt_path, \"w\") as fh:\n"
               "        fh.write(blob)\n"),
+    ("JL018", "import jax\n\ndef build(fn):\n    return jax.jit(fn)\n"),
 ])
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
     # JL004 is scoped to training/ paths; JL007 to speakingstyle_tpu/;
