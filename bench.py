@@ -1998,7 +1998,11 @@ def run_traffic(duration: float = 4.0, base_qps: float = 12.0,
 
     import jax
 
-    from speakingstyle_tpu.configs.config import AutoscaleConfig, FleetConfig
+    from speakingstyle_tpu.configs.config import (
+        AutoscaleConfig,
+        FleetConfig,
+        LongformConfig,
+    )
     from speakingstyle_tpu.faults import FaultPlan
     from speakingstyle_tpu.models.factory import build_model, init_variables
     from speakingstyle_tpu.models.hifigan import Generator
@@ -2011,6 +2015,7 @@ def run_traffic(duration: float = 4.0, base_qps: float = 12.0,
         SynthesisRequest,
     )
     from speakingstyle_tpu.serving.fleet import FAILED, FleetRouter
+    from speakingstyle_tpu.serving.longform import LongformService
     from speakingstyle_tpu.serving.style import StyleService
     from speakingstyle_tpu.serving.traffic import TrafficModel
 
@@ -2043,6 +2048,10 @@ def run_traffic(duration: float = 4.0, base_qps: float = 12.0,
             cooldown_down_s=1.0, max_step=2, assumed_warmup_s=5.0,
             warmup_cost_factor=0.5,
         ),
+        # chapter chunk groups share one storm-generous budget: a flash
+        # backlog must resolve as served-late, never as a chapter lost
+        # to its own per-chunk deadline
+        longform=LongformConfig(deadline_ms_per_chunk=30_000.0),
     ))
     serve = cfg.serve
     # the storm: steady (1 phase), flash (1 phase at 10x), recovery
@@ -2085,13 +2094,44 @@ def run_traffic(duration: float = 4.0, base_qps: float = 12.0,
                  for _ in range(16)]
 
     def make_request(i: int, ev) -> SynthesisRequest:
-        L = max(4, int(round(ev.length_frac * max_len)))
+        L = min(max_len, max(4, int(round(ev.length_frac * max_len))))
         return SynthesisRequest(
             id=f"traffic{i}",
             sequence=sequences[i % len(sequences)][:L],
             ref_mel=style_refs[ev.style],
             priority=ev.priority,
         )
+
+    # long_form arrivals (length_frac > 1) are CHAPTERS: they cannot ride
+    # the interactive lattice, so they go through the long-form service
+    # over the same router — each becomes a deadline-sharing chunk group.
+    # The synthetic frontend gives every sentence a fixed phoneme count,
+    # so a chapter's chunk plan is exact without G2P cost in the replay.
+    sent_ph = max(4, max_len // 2)
+
+    class _SyntheticFrontend:
+        def sequence(self, sent: str) -> np.ndarray:
+            return sequences[0][:sent_ph]
+
+        def resolve_style(self, payload):
+            return None, style_refs[int(payload.get("style_rank", 0))], False
+
+        def speaker(self, spec):
+            return 0
+
+    def chapter_payload(ev) -> dict:
+        n_sent = max(1, int(round(ev.length_frac * max_len / sent_ph)))
+        return {
+            "text": " ".join(f"s{j}." for j in range(n_sent)),
+            "style_rank": ev.style,
+        }
+
+    def run_chapter(i: int, ev) -> int:
+        plan_lf = longform_svc.admit(f"chapter{i}", chapter_payload(ev))
+        samples = 0
+        for piece in longform_svc.stream(plan_lf):
+            samples += piece.size
+        return samples
 
     registry = MetricsRegistry()
     plan = FaultPlan()
@@ -2110,6 +2150,14 @@ def run_traffic(duration: float = 4.0, base_qps: float = 12.0,
     router = FleetRouter(factory, cfg, replicas=min_replicas,
                          registry=registry, style=shared_style,
                          fault_plan=plan)
+    longform_svc = LongformService(
+        cfg, _SyntheticFrontend(), router, registry=registry,
+    )
+    from concurrent.futures import ThreadPoolExecutor
+
+    lf_pool = ThreadPoolExecutor(
+        max_workers=4, thread_name_prefix="bench-longform"
+    )
     if not router.wait_ready(timeout=600, n=min_replicas):
         print(json.dumps({
             "metric": "serve_traffic", "error": "replica never became ready",
@@ -2178,7 +2226,13 @@ def run_traffic(duration: float = 4.0, base_qps: float = 12.0,
             chaos_armed = True
         p = phase_of(ev.t)
         try:
-            pending.append((router.submit(make_request(i, ev)), p))
+            if ev.kind == "long_form":
+                # a chapter: admission + chunk-group synthesis on a
+                # drain worker; its future resolves when the last
+                # stitched piece has been consumed
+                pending.append((lf_pool.submit(run_chapter, i, ev), p))
+            else:
+                pending.append((router.submit(make_request(i, ev)), p))
         except Overloaded:
             counts[p]["shed"] += 1
         except Exception as e:
@@ -2191,9 +2245,14 @@ def run_traffic(duration: float = 4.0, base_qps: float = 12.0,
         try:
             fut.result(timeout=300)
             counts[p]["ok"] += 1
+        except Overloaded:
+            # a chapter's chunk submission hit the shed watermark
+            # mid-stream: backpressure, not loss
+            counts[p]["shed"] += 1
         except Exception as e:
             counts[p]["lost"] += 1
             counts[p]["errors"].append(type(e).__name__)
+    lf_pool.shutdown(wait=True)
 
     # post-storm: calm should shrink the fleet back to the floor; the
     # wait bound covers the calm window (scaled by the measured warm-up
@@ -2267,6 +2326,9 @@ def run_traffic(duration: float = 4.0, base_qps: float = 12.0,
         ),
         "requeued": int(registry.value("serve_requeued_total")),
         "autoscale_decisions": decisions,
+        "longform_chapters": int(registry.value(
+            "serve_longform_requests_total", {"tier": "chunked"})),
+        "longform_chunks": int(registry.value("serve_longform_chunks_total")),
         "proxy_device_ms": device_ms,
         "model": label,
     }
@@ -2645,6 +2707,242 @@ def run_mesh_serve(geometries=MESHSERVE_GEOMETRIES, duration: float = 3.0):
         }))
 
 
+def _longform_child(duration: float = 3.0):
+    """Inner body of --longform (re-exec'd with 2 forced host devices so
+    the ring tier has a seq mesh to shard over).
+
+    One chapter 10x the largest interactive lattice bucket (160 phonemes
+    against src_buckets=[16]) synthesized end-to-end on BOTH tiers:
+
+      * chunked — through the chapter chunker, the deadline-sharing
+        group on the continuous batcher, and the equal-power stitcher;
+        records chapter TTFA, full-chapter wall time, the per-seam
+        click-detector maximum (seam_rms_max), and the CompileMonitor
+        count across the measured chapters (must be 0);
+      * ring — one ring-attention program at the dedicated long-form
+        bucket (1 x 160 x 320 on a seq=2 mesh), streamed through the
+        engine's precompiled vocoder windows; records the same TTFA /
+        wall / compile numbers plus ring_vs_dense_mel_l2, the RMS
+        distance between the ring free-run's mel and the unsharded dense
+        model at the identical padded geometry (the sharding-correctness
+        parity the acceptance gate tracks).
+
+    CPU-proxy caveat (PERF.md): absolute times here measure scheduling
+    and stitching overhead on the tiny model — the honest signals are
+    the zero compile counts, the seam bound, and the parity distance,
+    not the milliseconds.
+    """
+    import dataclasses
+    import statistics
+
+    import numpy as np
+
+    import jax
+
+    from speakingstyle_tpu.configs.config import LongformConfig
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.models.hifigan import Generator
+    from speakingstyle_tpu.obs import MetricsRegistry
+    from speakingstyle_tpu.serving.batcher import ContinuousBatcher
+    from speakingstyle_tpu.serving.engine import (
+        CompileMonitor,
+        SynthesisEngine,
+        SynthesisRequest,
+    )
+    from speakingstyle_tpu.serving.longform import (
+        LongformService,
+        RingTier,
+        plan_chunks,
+    )
+    from speakingstyle_tpu.serving.server import TextFrontend
+
+    base = _tiny_serve_config()
+    serve = dataclasses.replace(
+        base.serve, batch_buckets=[1, 2, 4],
+        longform=LongformConfig(
+            mesh_seq=2, src_buckets=[160], mel_buckets=[320],
+            crossfade_frames=2, group_depth=4,
+            deadline_ms_per_chunk=30_000.0,
+        ),
+    )
+    cfg = dataclasses.replace(base, serve=serve)
+    lf = cfg.serve.longform
+
+    _mark("building long-form model parts")
+    reg = MetricsRegistry()
+    n_position = max(lf.mel_buckets[-1], lf.src_buckets[-1],
+                     cfg.model.max_seq_len) + 1
+    model = build_model(cfg, n_position=n_position)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    bias = variables["params"]["variance_adaptor"]["duration_predictor"][
+        "linear_layer"]["bias"]
+    variables["params"]["variance_adaptor"]["duration_predictor"][
+        "linear_layer"]["bias"] = bias + 1.1
+    gen = Generator(
+        upsample_rates=(2, 2), upsample_kernel_sizes=(4, 4),
+        upsample_initial_channel=16, resblock_kernel_sizes=(3,),
+        resblock_dilation_sizes=((1,),),
+    )
+    n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+    gparams = gen.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8, n_mels), np.float32)
+    )["params"]
+    engine = SynthesisEngine(cfg, variables, vocoder=(gen, gparams),
+                             model=model, registry=reg)
+    _mark(f"precompiling {len(engine.lattice)} interactive lattice points")
+    engine.precompile()
+    ring = RingTier(cfg, variables, engine, registry=reg)
+    _mark(f"precompiling {len(ring.lattice)} ring lattice points (seq=2)")
+    ring_precompile_s = ring.precompile()
+
+    rng = np.random.default_rng(0)
+    ref = rng.standard_normal((20, n_mels)).astype(np.float32)
+    frontend = TextFrontend(cfg, ref)
+    # 20 sentences x 8 words -> 160 phonemes under the tiny lexicon:
+    # 10x the largest interactive src bucket
+    words = ("one two three four five six seven eight."
+             " nine ten eleven twelve thirteen fourteen fifteen sixteen.")
+    text = " ".join(words for _ in range(10))
+
+    def run_tier(svc, tier, n_id):
+        """(ttfa_s, total_s, wav_samples, n_chunks) for one chapter."""
+        t0 = time.monotonic()
+        plan = svc.admit(f"bench.{tier}.{n_id}", {"text": text,
+                                                  "tier": tier})
+        assert plan.tier == tier, (plan.tier, tier)
+        ttfa, samples = None, 0
+        for piece in svc.stream(plan):
+            if ttfa is None:
+                ttfa = time.monotonic() - t0
+            samples += piece.size
+        return ttfa, time.monotonic() - t0, samples, len(plan.chunks)
+
+    chunks0 = plan_chunks(text, frontend.sequence,
+                          min(cfg.serve.src_buckets[-1],
+                              cfg.serve.mel_buckets[-1]
+                              // cfg.serve.frames_per_phoneme))
+    seq = np.concatenate([c.sequence for c in chunks0])
+    point = {
+        "metric": "serve_longform",
+        "chapter_phonemes": int(seq.size),
+        "chunks": len(chunks0),
+        "chapter_over_lattice": round(
+            seq.size / cfg.serve.src_buckets[-1], 2),
+    }
+    with ContinuousBatcher(engine) as batcher:
+        svc = LongformService(cfg, frontend, batcher, engine=engine,
+                              ring=ring, registry=reg)
+        for tier in ("chunked", "ring"):
+            run_tier(svc, tier, "warm")  # first-execution transfers
+            ttfas, totals, n = [], [], 0
+            stop_at = time.perf_counter() + duration
+            with CompileMonitor() as mon:
+                while n == 0 or time.perf_counter() < stop_at:
+                    ttfa, total, samples, _ = run_tier(svc, tier, n)
+                    ttfas.append(ttfa)
+                    totals.append(total)
+                    n += 1
+            point.update({
+                f"{tier}_chapters": n,
+                f"{tier}_ttfa_ms": round(
+                    1e3 * statistics.median(ttfas), 2),
+                f"{tier}_total_ms": round(
+                    1e3 * statistics.median(totals), 2),
+                f"{tier}_wav_samples": samples,
+                f"{tier}_steady_compiles": mon.count,
+            })
+        point.update({
+            "seams": reg.histogram("serve_longform_seam_rms").count,
+            "seam_rms_max": round(
+                reg.histogram("serve_longform_seam_rms").snapshot()["max"],
+                5),
+        })
+
+    # sharding-correctness parity: the ring free-run vs the unsharded
+    # dense model at the identical padded geometry (outside the compile
+    # monitors — the dense reference runs eagerly)
+    _mark("ring vs dense parity check")
+    sv = engine.style.encode_mels([ref])[0]
+    rres = ring.synthesize(
+        SynthesisRequest(id="parity", sequence=seq, ref_mel=None, style=sv)
+    )
+    l_pad, t_pad = lf.src_buckets[-1], lf.mel_buckets[-1]
+    texts = np.zeros((1, l_pad), np.int32)
+    texts[0, :seq.size] = seq
+    out = model.apply(
+        variables,
+        speakers=np.zeros((1,), np.int32),
+        texts=texts,
+        src_lens=np.asarray([seq.size], np.int32),
+        mels=None, mel_lens=None, max_mel_len=t_pad,
+        p_control=np.ones((1, l_pad), np.float32),
+        e_control=np.ones((1, l_pad), np.float32),
+        d_control=np.ones((1, l_pad), np.float32),
+        gammas=sv.gamma.reshape(1, 1, -1),
+        betas=sv.beta.reshape(1, 1, -1),
+        deterministic=True,
+    )
+    dense_mel = jax.device_get(out["mel_postnet"])[0, :rres.mel_len]
+    diff = rres.mel - dense_mel
+    point.update({
+        "ring_vs_dense_mel_l2": round(
+            float(np.sqrt(np.mean(diff * diff))), 6),
+        "ring_mel_len": rres.mel_len,
+        "ring_precompile_s": round(ring_precompile_s, 2),
+        "model": "tiny-cpu",
+        "platform": "cpu-proxy",
+    })
+    print(json.dumps(point))
+
+
+def run_longform(duration: float = 3.0):
+    """The --longform drill: chunked-vs-ring chapter synthesis in a
+    child process re-exec'd with ``--xla_force_host_platform_device_count
+    =2`` (the ring tier needs a seq mesh; the flag only binds before the
+    backend initializes — run_multichip's pattern). Emits ONE
+    {"metric": "serve_longform"} line; rides ``--compare`` as the
+    ``longform_*`` keys."""
+    import re
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        env.get("XLA_FLAGS", ""),
+    ).strip()
+    env["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count=2"
+    ).strip()
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             "--longform-inner", "--duration", str(duration)],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env,
+            cwd=here,
+        )
+    except subprocess.TimeoutExpired:
+        print(json.dumps({
+            "metric": "serve_longform", "error": "timeout after 600s",
+        }))
+        return
+    relayed = False
+    for ln in proc.stdout.strip().splitlines():
+        if ln.startswith("{"):
+            print(ln)
+            relayed = True
+    if not relayed:
+        print(json.dumps({
+            "metric": "serve_longform",
+            "error": f"rc={proc.returncode}: {proc.stderr[-300:]}",
+        }))
+
+
 REGRESSION_THRESHOLD = 0.10
 
 
@@ -2729,6 +3027,16 @@ def _absorb_record(rec, metrics):
         if isinstance(rec.get("steady_compiles"), (int, float)):
             metrics["traffic_steady_compiles"] = (
                 float(rec["steady_compiles"]), "lower")
+    elif m == "serve_longform":
+        # chapter synthesis on both tiers; the compile counts ride as
+        # lower-is-better (floor and expected value: zero), seam_rms_max
+        # is the click-detector bound, ring_vs_dense_mel_l2 the
+        # sharding-correctness parity distance
+        for k in ("chunked_ttfa_ms", "chunked_total_ms", "ring_ttfa_ms",
+                  "ring_total_ms", "seam_rms_max", "ring_vs_dense_mel_l2",
+                  "chunked_steady_compiles", "ring_steady_compiles"):
+            if isinstance(rec.get(k), (int, float)):
+                metrics[f"longform_{k}"] = (float(rec[k]), "lower")
     elif m == "train_multichip":
         n = rec.get("n_devices")
         if isinstance(rec.get("frames_per_sec_per_chip"), (int, float)):
@@ -2973,6 +3281,7 @@ if __name__ == "__main__":
         run_traffic(duration=dur)
         run_rollout(duration=dur)
         run_mesh_serve(duration=dur)
+        run_longform(duration=dur)
     elif "--rollout" in sys.argv:
         dur = (float(sys.argv[sys.argv.index("--duration") + 1])
                if "--duration" in sys.argv else 3.0)
@@ -3013,6 +3322,14 @@ if __name__ == "__main__":
         dur = (float(sys.argv[sys.argv.index("--duration") + 1])
                if "--duration" in sys.argv else 3.0)
         run_mesh_serve(duration=dur)
+    elif "--longform-inner" in sys.argv:
+        dur = (float(sys.argv[sys.argv.index("--duration") + 1])
+               if "--duration" in sys.argv else 3.0)
+        _longform_child(duration=dur)
+    elif "--longform" in sys.argv:
+        dur = (float(sys.argv[sys.argv.index("--duration") + 1])
+               if "--duration" in sys.argv else 3.0)
+        run_longform(duration=dur)
     elif "--compare" in sys.argv:
         i = sys.argv.index("--compare")
         rest = [a for a in sys.argv[i + 1:] if not a.startswith("--")]

@@ -78,6 +78,17 @@ JL018  XLA compilation outside the program registry: any reference to
        for AOT, jit_program for jit-on-call wrappers), which is what
        makes the zero-steady-state-compiles invariant structural;
        precompile/warmup fixtures are exempt. Tree baseline: zero.
+JL019  full-utterance accumulation in serving code: a list that is
+       ``.append``/``.extend``-ed inside a loop and later passed to
+       np.concatenate/jnp.concatenate in the same scope, under
+       speakingstyle_tpu/serving/ — the accumulate-then-concat shape
+       materializes an entire utterance (or chapter) host-side, which
+       is exactly what the bounded-memory streaming contract forbids:
+       long-form output must flow window-by-window (serving/
+       streaming.py) or seam-by-seam (serving/longform.py), never be
+       rebuilt whole. Complements JL015 (which flags the concatenate
+       CALL in a loop/handler; JL019 catches the concat-after-loop
+       spelling JL015's loop test misses). Tree baseline: zero.
 """
 
 import ast
@@ -2028,6 +2039,89 @@ def rule_jl018(mod: ModuleInfo) -> Iterator[Finding]:
                 yield _finding(node, ".lower().compile()")
 
 
+# ---------------------------------------------------------------------------
+# JL019 — full-utterance accumulation (append-in-loop + concatenate)
+# ---------------------------------------------------------------------------
+
+
+_CONCAT_CALLS = {
+    "np.concatenate", "numpy.concatenate", "jnp.concatenate",
+    "jax.numpy.concatenate",
+}
+_ACCUM_METHODS = {"append", "extend"}
+
+
+def rule_jl019(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL019: full-utterance accumulation under
+    ``speakingstyle_tpu/serving/`` — a list ``.append``/``.extend``-ed
+    inside a loop and then handed to ``np.concatenate`` /
+    ``jnp.concatenate`` in the same scope.
+
+    The bounded-memory contract for served audio is structural: the
+    streaming path emits overlap-trimmed windows (serving/streaming.py)
+    and the long-form path emits crossfaded seams (serving/longform.py),
+    so at no point does the host hold a whole utterance — let alone a
+    chapter — as one buffer.  The accumulate-then-concat shape
+    (``pieces.append(wav)`` in the chunk loop, ``np.concatenate(pieces)``
+    after it) silently re-materializes that buffer: memory scales with
+    requested AUDIO LENGTH instead of with the in-flight window count,
+    and one hour-long chapter OOMs the serving host.  Yield the pieces
+    instead.  JL015 flags a ``concatenate`` *call* inside a loop or
+    handler; this rule catches the spelling where the call sits after
+    the loop and only the appends are inside it.  Functions named
+    ``precompile``/``warmup`` are exempt (startup fixtures); the tree
+    baseline for this rule is zero and must stay zero.
+    """
+    p = mod.path.replace("\\", "/")
+    if "speakingstyle_tpu/serving/" not in p:
+        return
+    # scope id -> names of lists grown inside a loop in that scope
+    grown: Dict[int, Set[str]] = {}
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr in _ACCUM_METHODS
+                and isinstance(f.value, ast.Name)):
+            continue
+        if not mod.enclosing_loops(node):
+            continue
+        scope = mod.enclosing_function(node)
+        grown.setdefault(id(scope), set()).add(f.value.id)
+    if not grown:
+        return
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func)
+        if callee not in _CONCAT_CALLS or not node.args:
+            continue
+        qual = mod.qualname(node)
+        if any(m in qual.lower() for m in _COMPILE_EXEMPT_MARKERS):
+            continue
+        scope = mod.enclosing_function(node)
+        names = grown.get(id(scope), set())
+        arg = node.args[0]
+        arg_names = {n.id for n in ast.walk(arg) if isinstance(n, ast.Name)}
+        for name in sorted(arg_names & names):
+            yield Finding(
+                rule="JL019",
+                path=mod.path,
+                line=node.lineno,
+                context=qual,
+                detail=f"{callee}({name}) after loop accumulation",
+                message=(
+                    f"`{callee}({name})` consumes a list grown inside a "
+                    f"loop ({qual}): accumulate-then-concat materializes "
+                    "the full utterance/chapter host-side, so memory "
+                    "scales with audio length instead of the in-flight "
+                    "window bound. Yield the pieces as they are produced "
+                    "(streaming.stream_wav / longform.Stitcher are the "
+                    "reference idioms)."
+                ),
+            )
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -2047,4 +2141,5 @@ RULES = {
     "JL016": rule_jl016,
     "JL017": rule_jl017,
     "JL018": rule_jl018,
+    "JL019": rule_jl019,
 }

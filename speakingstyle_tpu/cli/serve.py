@@ -16,6 +16,11 @@ lattice (``serve.*`` config block), then serves:
   POST /synthesize/stream -> chunked audio/wav: overlap-trimmed windows
                        emitted as they are vocoded (serving/streaming.py)
                        — time-to-first-audio is the first-window bound
+  POST /synthesize/longform -> chapter-length chunked audio/wav
+                       (serving/longform.py): sentence-boundary chunking
+                       + crossfade stitching through the batcher, or one
+                       seq-sharded ring-attention program per chapter
+                       when serve.longform.mesh_seq > 1
   GET  /healthz     -> engine/batcher stats (compile counter must stay at
                        its post-startup value: steady state never
                        compiles); 503 with per-replica lifecycle states
@@ -329,6 +334,25 @@ def main(args):
             events=events,
             model_info=dict(info, version=model_version_string(info)),
         )
+        if cfg.serve.longform.mesh_seq > 1:
+            # ring tier: the chapter-length free-run as ONE seq-sharded
+            # program set, compiled now (startup, not request path) and
+            # attached to the server's auto-built LongformService so both
+            # tiers share the one batcher/engine. Fleet mode serves the
+            # chunked tier only — a ring tier would need its own
+            # per-replica seq mesh, and the chunked tier already rides
+            # the replicas.
+            from speakingstyle_tpu.serving.longform import RingTier
+
+            ring = RingTier(cfg, variables, engine)
+            print(
+                f"precompiling {len(ring.lattice)} ring-attention "
+                f"long-form points (seq mesh of "
+                f"{cfg.serve.longform.mesh_seq}) ...", flush=True,
+            )
+            ring_secs = ring.precompile()
+            print(f"ring tier ready in {ring_secs:.1f}s", flush=True)
+            server.longform.ring = ring
 
     # SIGTERM contract: stop accepting, drain in-flight streams (up to
     # serve.fleet.drain_timeout_s), flush admitted requests, exit.
@@ -346,8 +370,9 @@ def main(args):
         "(1 = sequential vocode)", flush=True,
     )
     print(f"serving on http://{host}:{port} "
-          "(POST /synthesize, POST /synthesize/stream, POST /styles, "
-          "GET /styles, GET /healthz, GET /metrics, GET /debug/programs, "
+          "(POST /synthesize, POST /synthesize/stream, "
+          "POST /synthesize/longform, POST /styles, GET /styles, "
+          "GET /healthz, GET /metrics, GET /debug/programs, "
           "POST /debug/profile?seconds=N)", flush=True)
     try:
         server.serve_forever()

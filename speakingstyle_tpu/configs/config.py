@@ -598,6 +598,14 @@ class FleetConfig:
     # layer bounds future.result(timeout=...) — the deadline is enforced
     # in the router; the grace covers result readback + response writing
     deadline_grace_ms: float = 500.0
+    # ceiling for per-request deadline overrides: a request may carry its
+    # own deadline_ms (a long-form chapter group's budget scales with its
+    # chunk count instead of inheriting the flat class budget); the
+    # router clamps any override into (0, max_deadline_ms] so a client
+    # cannot park an entry in the EDF heap forever. 0.0 (the default)
+    # derives max(120000.0, largest class deadline); an explicit value
+    # must be >= every class deadline
+    max_deadline_ms: float = 0.0
 
     def __post_init__(self):
         if self.replicas < 1:
@@ -662,6 +670,22 @@ class FleetConfig:
             raise ValueError(
                 f"fleet.deadline_grace_ms must be >= 0, got "
                 f"{self.deadline_grace_ms}"
+            )
+        if self.max_deadline_ms < 0:
+            raise ValueError(
+                f"fleet.max_deadline_ms must be >= 0 (0 = derive), got "
+                f"{self.max_deadline_ms}"
+            )
+        if self.max_deadline_ms == 0.0:
+            object.__setattr__(
+                self, "max_deadline_ms",
+                max(120000.0, max(self.class_deadline_ms.values())),
+            )
+        elif self.max_deadline_ms < max(self.class_deadline_ms.values()):
+            raise ValueError(
+                "fleet.max_deadline_ms must be >= every class deadline "
+                f"(it is the override ceiling), got {self.max_deadline_ms} "
+                f"< max of {self.class_deadline_ms}"
             )
 
 
@@ -869,6 +893,104 @@ class RolloutConfig:
 
 
 @dataclass(frozen=True)
+class LongformConfig:
+    """Long-form (chapter-length) synthesis knobs (serving/longform.py —
+    ARCHITECTURE.md "Long-form synthesis").
+
+    Two tiers behind ``POST /synthesize/longform``. **Chunked** (always
+    available): the chapter is split at sentence boundaries into
+    utterances that each fit the interactive lattice, synthesized as a
+    deadline-sharing group of ``long_form``-class requests through the
+    existing batcher/fleet, and stitched with prosodic continuity —
+    per-chunk duration/pitch/energy controls carried across the seam
+    plus an equal-power crossfade — streamed chunk-by-chunk (bounded
+    memory, jaxlint JL019). **Ring** (``mesh_seq > 1``): one coherent
+    chapter-length utterance compiled as a single ring-attention program
+    over a ``seq``-axis mesh at the ``longform`` buckets below, with
+    tier-b→tier-a degradation on ring failure decided at admission.
+    """
+
+    # seq-axis mesh size for the ring tier: devices the chapter-length
+    # free-run shards its attention over (parallel/ring_attention.py);
+    # 0 or 1 = chunked tier only (no ring programs compiled)
+    mesh_seq: int = 0
+    # padded text lengths the ring tier compiles for — the long-form
+    # lattice ABOVE serve.src_buckets[-1]; every value must be divisible
+    # by mesh_seq (ring shards the length axis evenly)
+    src_buckets: List[int] = field(default_factory=lambda: [512, 1024])
+    # padded mel lengths for the ring free-run output buffer (defaults
+    # pair with src_buckets at serve.frames_per_phoneme=12); same
+    # divisibility contract as src_buckets
+    mel_buckets: List[int] = field(default_factory=lambda: [6144, 12288])
+    # mel frames of equal-power crossfade at each chunk seam (chunked
+    # tier); converted to wav samples via the vocoder hop
+    crossfade_frames: int = 8
+    # admission cap on chapter size (chunks after sentence packing)
+    max_chunks: int = 64
+    # chunked-tier in-flight bound: at most this many chunk requests are
+    # submitted ahead of the stitch point, so resident memory is
+    # O(group_depth) chunk wavs — never the whole chapter (jaxlint JL019
+    # polices the concatenate-the-chapter failure mode)
+    group_depth: int = 4
+    # per-chunk share of the chapter group's deadline budget: the group
+    # budget is n_chunks * this, clamped to fleet.max_deadline_ms
+    deadline_ms_per_chunk: float = 2000.0
+    # tier selection at admission: "auto" rings when the ring tier is up
+    # and the chapter fits a ring bucket, else chunks; "chunked"/"ring"
+    # force a tier ("ring" still degrades to chunked on failure)
+    tier: str = "auto"
+
+    def __post_init__(self):
+        if self.mesh_seq < 0:
+            raise ValueError(
+                f"serve.longform.mesh_seq must be >= 0, got {self.mesh_seq}"
+            )
+        for name in ("src_buckets", "mel_buckets"):
+            vals = getattr(self, name)
+            if not vals:
+                raise ValueError(f"serve.longform.{name} must be non-empty")
+            if any(v <= 0 for v in vals):
+                raise ValueError(
+                    f"serve.longform.{name} must be positive, got {vals}"
+                )
+            if sorted(vals) != list(vals) or len(set(vals)) != len(vals):
+                raise ValueError(
+                    f"serve.longform.{name} must be strictly ascending, "
+                    f"got {vals}"
+                )
+            if self.mesh_seq > 1 and any(v % self.mesh_seq for v in vals):
+                raise ValueError(
+                    f"serve.longform.{name} must be divisible by "
+                    f"mesh_seq={self.mesh_seq} (ring shards the length "
+                    f"axis evenly), got {vals}"
+                )
+        if self.crossfade_frames < 0:
+            raise ValueError(
+                f"serve.longform.crossfade_frames must be >= 0, "
+                f"got {self.crossfade_frames}"
+            )
+        if self.max_chunks <= 0:
+            raise ValueError(
+                f"serve.longform.max_chunks must be > 0, got {self.max_chunks}"
+            )
+        if self.group_depth < 1:
+            raise ValueError(
+                f"serve.longform.group_depth must be >= 1, "
+                f"got {self.group_depth}"
+            )
+        if self.deadline_ms_per_chunk <= 0:
+            raise ValueError(
+                f"serve.longform.deadline_ms_per_chunk must be > 0, "
+                f"got {self.deadline_ms_per_chunk}"
+            )
+        if self.tier not in ("auto", "chunked", "ring"):
+            raise ValueError(
+                "serve.longform.tier must be 'auto'|'chunked'|'ring', "
+                f"got {self.tier!r}"
+            )
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Continuous-batching synthesis server knobs (serving/engine.py,
     serving/batcher.py).
@@ -928,6 +1050,9 @@ class ServeConfig:
     style: StyleConfig = field(default_factory=StyleConfig)
     # canary-gated rolling model rollout (disabled by default)
     rollout: RolloutConfig = field(default_factory=RolloutConfig)
+    # long-form (chapter-length) synthesis: chunk+stitch tier always on,
+    # ring-attention tier when longform.mesh_seq > 1
+    longform: LongformConfig = field(default_factory=LongformConfig)
     # mesh geometry of ONE replica (parallel/mesh.py resolve_mesh — the
     # same resolution path as train.parallel): [1, 1] keeps the
     # single-device engine byte-for-byte; [dp, tp] makes every replica a

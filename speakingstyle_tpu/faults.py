@@ -38,6 +38,11 @@ Counter semantics per kind:
   ``vocoder_raise@N``       the engine's Nth ``vocode_window`` call
                             (per engine instance) raises — a streaming
                             continuation fault
+  ``longform_ring_error@N`` the LongformService's Nth ring-tier
+                            synthesis attempt (per service instance,
+                            1-based) raises InjectedFault before device
+                            work — drives the tier-b→tier-a
+                            (ring→chunked) degradation drill
 
   checkpoint (training/checkpoint.py; the lifecycle drills):
 
@@ -67,6 +72,7 @@ ENV_VAR = "SPEAKINGSTYLE_FAULTS"
 TRAINING_KINDS = ("loader_ioerror", "nan_grads", "sigterm")
 SERVING_KINDS = (
     "replica_raise", "replica_hang", "style_encode_error", "vocoder_raise",
+    "longform_ring_error",
 )
 CHECKPOINT_KINDS = ("checkpoint_corrupt", "manifest_missing")
 KINDS = TRAINING_KINDS + SERVING_KINDS + CHECKPOINT_KINDS

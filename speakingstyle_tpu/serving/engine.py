@@ -132,6 +132,11 @@ class SynthesisRequest:
     # SLO priority class (serve.fleet.class_deadline_ms key); None means
     # the fleet's default_class — ignored by the single-engine batcher
     priority: Optional[str] = None
+    # per-request SLO budget override in ms (None = the class deadline):
+    # a long-form chapter group's budget scales with its chunk count
+    # instead of inheriting the flat class budget; the router clamps the
+    # override to serve.fleet.max_deadline_ms
+    deadline_ms: Optional[float] = None
     # style resolution already degraded to the default style upstream
     # (the HTTP frontend's encoder call failed); carried through to the
     # result so the response can say X-Style-Degraded

@@ -534,6 +534,22 @@ class FleetRouter:
         self.lattice.cover(1, len(req.sequence), need_mel)
         return klass
 
+    def _budget_s(self, req: SynthesisRequest, klass: str) -> float:
+        """Effective SLO budget in seconds: the class deadline, unless
+        the request carries a ``deadline_ms`` override (a long-form
+        chapter group's budget scales with its chunk count), clamped to
+        ``fleet.max_deadline_ms`` so a client cannot park an entry in
+        the EDF heap forever."""
+        override = getattr(req, "deadline_ms", None)
+        if override is None:
+            return self.fleet.class_deadline_ms[klass] / 1e3
+        if override <= 0:
+            raise ValueError(
+                f"request {getattr(req, 'id', '?')!r}: deadline_ms "
+                f"override must be > 0, got {override}"
+            )
+        return min(float(override), self.fleet.max_deadline_ms) / 1e3
+
     def _check_shed(self) -> None:
         """Watermark hysteresis; caller holds ``self._cond``."""
         depth = len(self._heap)
@@ -570,7 +586,7 @@ class FleetRouter:
                 self._rejected_ctr.inc()
                 raise ShutdownError("router is closed")
             self._check_shed()
-            budget = self.fleet.class_deadline_ms[klass] / 1e3
+            budget = self._budget_s(request, klass)
             self._seq += 1
             heapq.heappush(self._heap, _Pending(
                 slo_deadline=request.arrival + budget,
@@ -667,7 +683,7 @@ class FleetRouter:
                 "deadline_exceeded", req_id=p.request.id, klass=p.klass,
                 retries=p.retries,
             )
-        budget = self.fleet.class_deadline_ms[p.klass]
+        budget = self._budget_s(p.request, p.klass) * 1e3
         # an expiry removes the entry from the heap for good — it drains
         # the queue exactly as a dispatch does for Retry-After purposes
         self.drain_rate.note(1)

@@ -431,6 +431,7 @@ class SynthesisServer:
         lifecycle=None,  # RolloutManager: gates POST /admin/rollout
         model_info: Optional[Dict] = None,  # single-engine identity
         # (fleet mode reads the router's set_model_version state instead)
+        longform=None,  # LongformService; auto-built when a frontend exists
     ):
         if engine is None and router is None:
             raise ValueError("SynthesisServer needs an engine or a router")
@@ -461,6 +462,24 @@ class SynthesisServer:
             self.batcher = ContinuousBatcher(engine, events=events)
             self.backend = self.batcher
         self.request_timeout = request_timeout
+        # long-form chapters (POST /synthesize/longform): the chunked
+        # tier needs only the frontend + backend already in hand, so the
+        # service is built by default; a ring tier rides in only when the
+        # caller wires one explicitly (cli/serve.py, bench) via the
+        # ``longform`` ctor arg — it needs its own seq-mesh programs
+        if longform is None and frontend is not None:
+            from speakingstyle_tpu.serving.longform import LongformService
+
+            longform = LongformService(
+                self.cfg, frontend, self.backend,
+                engine=engine,
+                fault_plan=getattr(
+                    engine if engine is not None else router,
+                    "fault_plan", None,
+                ),
+                registry=self.registry, events=events,
+            )
+        self.longform = longform
         # frontend overlap (serving/frontend.py): with workers > 0 the
         # handler submits a PendingRequest and the G2P runs on the pool,
         # hidden under the backend's coalescing wait; 0 = inline frontend
@@ -585,6 +604,8 @@ class SynthesisServer:
                     return self._rollout()
                 if parsed.path == "/styles":
                     return self._post_style(parsed)
+                if parsed.path == "/synthesize/longform":
+                    return self._synthesize_longform(parsed)
                 if parsed.path == "/synthesize/stream":
                     return self._synthesize(parsed, stream=True)
                 if parsed.path == "/synthesize":
@@ -691,6 +712,7 @@ class SynthesisServer:
                 req_id = outer.next_req_id()
                 t0 = time.monotonic()
                 status, err, headers = 200, None, None
+                extra_body = None
                 try:
                     n = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(n) or b"{}")
@@ -702,7 +724,15 @@ class SynthesisServer:
                     result = outer.synthesize(
                         payload, req_id=req_id, stream=stream
                     )
-                except (ValueError, RequestTooLarge) as e:
+                except RequestTooLarge as e:
+                    # structured 413: the body states the admissible
+                    # ceiling and points at the long-form endpoint, so a
+                    # client can route the chapter instead of guessing
+                    # at the limit (RequestTooLarge IS a ValueError —
+                    # this arm must come first)
+                    status, err = 413, str(e)
+                    extra_body = outer.too_large_body()
+                except ValueError as e:
                     status, err = 400, str(e)
                 except Overloaded as e:
                     # backpressure shed: NOT the shutdown path — carries
@@ -730,7 +760,10 @@ class SynthesisServer:
                     status, err = 504, "synthesis timed out"
                 if err is not None:
                     outer._request_done(req_id, parsed.path, status, t0)
-                    return self._json(status, {"error": err, "id": req_id},
+                    body = {"error": err, "id": req_id}
+                    if extra_body:
+                        body.update(extra_body)
+                    return self._json(status, body,
                                       req_id=req_id, headers=headers)
                 if stream:
                     return self._stream_response(result, req_id, parsed, t0)
@@ -808,6 +841,109 @@ class SynthesisServer:
                     return
                 outer._request_done(req_id, parsed.path, 200, t0)
 
+            def _synthesize_longform(self, parsed):
+                """POST /synthesize/longform: chapter in, one chunked
+                audio/wav stream out.  The FIRST stitched piece is
+                pulled before any header goes on the wire, so admission
+                errors AND a ring-tier failure that degrades to the
+                chunked tier are both reflected honestly (clean JSON
+                error / an ``X-Longform-Tier`` header naming the tier
+                that actually produced the audio)."""
+                req_id = outer.next_req_id()
+                t0 = time.monotonic()
+                status, err, headers, extra_body = 200, None, None, None
+                try:
+                    if outer.longform is None:
+                        raise ValueError(
+                            "long-form synthesis needs a text frontend"
+                        )
+                    if not outer.streaming_available():
+                        raise ValueError(
+                            "long-form synthesis requires a vocoder "
+                            "engine (--griffin_lim serves mel JSON only)"
+                        )
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                    plan = outer.longform.admit(req_id, payload)
+                    pieces = outer.longform.stream(plan)
+                    first = next(pieces, None)
+                except RequestTooLarge as e:
+                    # past even the long-form admission cap
+                    status, err = 413, str(e)
+                    extra_body = outer.too_large_body()
+                    extra_body["max_chunks"] = \
+                        outer.cfg.serve.longform.max_chunks
+                except ValueError as e:
+                    status, err = 400, str(e)
+                except Overloaded as e:
+                    status, err = 429, str(e)
+                    headers = {
+                        "Retry-After": str(max(1, int(e.retry_after_s)))
+                    }
+                except ShutdownError as e:
+                    status, err = 503, str(e)
+                except DeadlineExceeded as e:
+                    status, err = 504, str(e)
+                except ReplicaError as e:
+                    status, err = 503, str(e)
+                except DispatchError as e:
+                    status, err = 500, str(e)
+                except (TimeoutError, concurrent.futures.TimeoutError):
+                    status, err = 504, "long-form synthesis timed out"
+                if err is not None:
+                    outer._request_done(req_id, parsed.path, status, t0)
+                    body = {"error": err, "id": req_id}
+                    if extra_body:
+                        body.update(extra_body)
+                    return self._json(status, body,
+                                      req_id=req_id, headers=headers)
+                sr = outer.cfg.preprocess.preprocessing.audio.sampling_rate
+
+                def write_chunk(data: bytes):
+                    self.wfile.write(b"%X\r\n" % len(data))
+                    self.wfile.write(data)
+                    self.wfile.write(b"\r\n")
+
+                self.send_response(200)
+                self.send_header("Content-Type", "audio/wav")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.send_header("X-Request-Id", req_id)
+                # the tier that is actually producing audio — a ring
+                # failure degraded the plan before headers went out
+                self.send_header("X-Longform-Tier", plan.tier)
+                self.send_header("X-Longform-Chunks",
+                                 str(len(plan.chunks)))
+                if plan.style_degraded:
+                    self.send_header("X-Style-Degraded", "1")
+                version = outer.model_version()
+                if version is not None:
+                    self.send_header("X-Model-Version", version)
+                self.end_headers()
+                try:
+                    with outer.stream_scope():
+                        write_chunk(wav_stream_header(sr))
+                        if first is not None:
+                            write_chunk(first.tobytes())
+                        for wav in pieces:
+                            write_chunk(wav.tobytes())
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    self.close_connection = True
+                    outer._request_done(req_id, parsed.path, 499, t0)
+                    return
+                except Exception as e:
+                    # headers are gone — the only honest signal is a
+                    # truncated chunked body (no terminal chunk)
+                    self.close_connection = True
+                    outer._request_done(req_id, parsed.path, 500, t0)
+                    if outer.events is not None:
+                        outer.events.emit(
+                            "stream_abort", req_id=req_id,
+                            error=type(e).__name__,
+                        )
+                    return
+                outer._request_done(req_id, parsed.path, 200, t0)
+
             def _profile(self, parsed):
                 if not outer.cfg.serve.debug_profile:
                     return self._json(
@@ -839,6 +975,21 @@ class SynthesisServer:
     def next_req_id(self) -> str:
         return f"req{int(self._requests.inc()):08d}"
 
+    def too_large_body(self) -> Dict:
+        """The structured 413 payload: the interactive lattice's
+        admissible ceiling per axis plus the endpoint that DOES take
+        chapters, so an over-limit client can route instead of guess."""
+        serve = self.cfg.serve
+        return {
+            "max_src": serve.src_buckets[-1],
+            "max_mel": serve.mel_buckets[-1],
+            "max_phonemes": min(
+                serve.src_buckets[-1],
+                serve.mel_buckets[-1] // serve.frames_per_phoneme,
+            ),
+            "longform": "/synthesize/longform",
+        }
+
     def _result_timeout(self, request) -> float:
         """Wait on a submitted future no longer than the request's class
         deadline budget (+ grace) allows.  The router resolves expired
@@ -849,7 +1000,11 @@ class SynthesisServer:
             return self.request_timeout
         fleet = self.cfg.serve.fleet
         klass = request.priority or fleet.default_class
-        budget_ms = fleet.class_deadline_ms.get(klass)
+        override = getattr(request, "deadline_ms", None)
+        if override is not None:
+            budget_ms = min(float(override), fleet.max_deadline_ms)
+        else:
+            budget_ms = fleet.class_deadline_ms.get(klass)
         if budget_ms is None:
             return self.request_timeout
         deadline = request.arrival + (budget_ms + fleet.deadline_grace_ms) / 1e3

@@ -32,8 +32,11 @@ import numpy as np
 __all__ = ["TrafficEvent", "TrafficModel", "DEFAULT_MIX", "DEFAULT_PRIORITY_MAP"]
 
 # traffic kinds and how they ride the router's existing SLO classes:
-# long-form jobs are batch-class work that happens to fill the largest
-# buckets (length_frac 1.0) — the router needs no third class for them
+# long-form jobs are batch-class CHAPTERS — length_frac > 1 means the
+# request exceeds the interactive lattice and must ride
+# /synthesize/longform (serving/longform.py), where a chapter becomes a
+# deadline-sharing chunk group (or one ring-attention program); the
+# router still needs no third class for them
 DEFAULT_MIX: Dict[str, float] = {
     "interactive": 0.6,
     "batch": 0.3,
@@ -45,11 +48,14 @@ DEFAULT_PRIORITY_MAP: Dict[str, str] = {
     "long_form": "batch",
 }
 # relative utterance length per kind: (lo, hi) fractions of the longest
-# admissible request; long-form pins the top bucket
+# interactively admissible request. Long-form draws REAL chapter
+# lengths — multiples of the interactive ceiling — so a traffic replay
+# exercises the long-form admission path instead of merely pinning the
+# top interactive bucket
 _LENGTH_RANGES: Dict[str, Tuple[float, float]] = {
     "interactive": (0.25, 0.5),
     "batch": (0.4, 0.8),
-    "long_form": (1.0, 1.0),
+    "long_form": (2.0, 8.0),
 }
 
 
@@ -62,6 +68,7 @@ class TrafficEvent:
     priority: str       # the router SLO class the kind rides
     style: int          # zipf-ranked style index (0 = hottest voice)
     length_frac: float  # utterance length as a fraction of the max
+                        # interactive request; > 1 = a long-form chapter
 
 
 class TrafficModel:

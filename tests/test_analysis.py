@@ -1124,6 +1124,109 @@ def test_jl018_tree_baseline_is_zero():
 
 
 # ---------------------------------------------------------------------------
+# JL019 — full-utterance accumulation (append-in-loop + concatenate)
+# ---------------------------------------------------------------------------
+
+
+def test_jl019_positive_append_loop_then_concatenate():
+    # the concatenate sits AFTER the loop, so JL015's in-loop test never
+    # sees it — this is exactly the spelling JL019 exists for
+    src = """
+        import numpy as np
+
+        def collect(chunks):
+            pieces = []
+            for c in chunks:
+                pieces.append(c.wav)
+            return np.concatenate(pieces)
+    """
+    found = [
+        f for f in linter.lint_source(textwrap.dedent(src), _SERVING_PATH)
+        if f.rule == "JL019"
+    ]
+    assert len(found) == 1
+    assert found[0].detail == "np.concatenate(pieces) after loop accumulation"
+
+
+def test_jl019_positive_jnp_and_extend():
+    assert "JL019" in _codes("""
+        import jax.numpy as jnp
+
+        def gather(windows):
+            mels = []
+            while windows:
+                mels.extend(windows.pop())
+            return jnp.concatenate(mels, axis=0)
+    """, path=_SERVING_PATH)
+
+
+def test_jl019_negative_streaming_yield_and_comprehension():
+    # the sanctioned shapes: yield pieces as they are produced, or a
+    # concatenate over a comprehension/static list (no loop-grown
+    # accumulator — small, bounded, not utterance-scale)
+    assert "JL019" not in _codes("""
+        import numpy as np
+
+        def stream(chunks):
+            for c in chunks:
+                yield c.wav
+
+        def pack(rows):
+            return np.concatenate([r.head for r in rows])
+    """, path=_SERVING_PATH)
+
+
+def test_jl019_negative_scope_and_path():
+    # a list grown in ONE function and concatenated in another is not
+    # the pattern (the accumulator never coexists with the concat), and
+    # non-serving code may accumulate freely
+    assert "JL019" not in _codes("""
+        import numpy as np
+
+        def grow(chunks):
+            pieces = []
+            for c in chunks:
+                pieces.append(c)
+            return pieces
+
+        def join(pieces):
+            return np.concatenate(pieces)
+    """, path=_SERVING_PATH)
+    assert "JL019" not in _codes("""
+        import numpy as np
+
+        def collect(chunks):
+            pieces = []
+            for c in chunks:
+                pieces.append(c)
+            return np.concatenate(pieces)
+    """, path="speakingstyle_tpu/training/fake.py")
+
+
+def test_jl019_negative_precompile_exempt():
+    assert "JL019" not in _codes("""
+        import numpy as np
+
+        def precompile(points):
+            shapes = []
+            for p in points:
+                shapes.append(np.zeros(p))
+            return np.concatenate(shapes)
+    """, path=_SERVING_PATH)
+
+
+def test_jl019_tree_baseline_is_zero():
+    """The long-form subsystem's bounded-memory claim, structurally: no
+    serving file accumulates-then-concatenates a full utterance (the
+    Stitcher holds one crossfade tail; streaming emits windows)."""
+    findings = [f for f in linter.lint_paths() if f.rule == "JL019"]
+    assert findings == [], (
+        "JL019 must stay at zero tree findings — stream pieces instead "
+        f"of rebuilding utterances: {[f.fingerprint for f in findings]}"
+    )
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -1258,6 +1361,10 @@ def test_every_rule_is_non_vacuous():
     # JL018 is absent BY CONSTRUCTION: the registry migration removed
     # every jax.jit / .lower().compile() spelling from the enforced
     # tree, and test_jl018_tree_baseline_is_zero pins it at zero.
+    # JL019 is likewise absent by construction: the long-form subsystem
+    # was written streaming-first (Stitcher seams, window yields), and
+    # test_jl019_tree_baseline_is_zero pins the accumulate-then-concat
+    # count at zero.
     for code in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
                  "JL007", "JL008"):
         assert code in fired, f"{code} never fires on the real tree"
@@ -1303,12 +1410,16 @@ def test_cli_check_exits_zero_on_repo():
               "    with open(ckpt_path, \"w\") as fh:\n"
               "        fh.write(blob)\n"),
     ("JL018", "import jax\n\ndef build(fn):\n    return jax.jit(fn)\n"),
+    ("JL019", "import numpy as np\n\ndef collect(chunks):\n    out = []\n"
+              "    for c in chunks:\n        out.append(c)\n"
+              "    return np.concatenate(out)\n"),
 ])
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
     # JL004 is scoped to training/ paths; JL007 to speakingstyle_tpu/;
-    # JL011-JL013, JL015 and JL016 to speakingstyle_tpu/serving/;
+    # JL011-JL013, JL015, JL016 and JL019 to speakingstyle_tpu/serving/;
     # JL017 to both training/ and serving/ (training default suffices)
-    sub = ("serving" if code in ("JL011", "JL012", "JL013", "JL015", "JL016")
+    sub = ("serving" if code in ("JL011", "JL012", "JL013", "JL015", "JL016",
+                                 "JL019")
            else "training")
     d = tmp_path / "speakingstyle_tpu" / sub
     d.mkdir(parents=True)

@@ -1,0 +1,702 @@
+"""Long-form synthesis (tier-1): chunker, stitcher, service, ring.
+
+Five layers, mirroring serving/longform.py:
+  1. chapter chunker — determinism, exact packing, giant-sentence /
+     empty / unicode edges (pure python, no jax);
+  2. prosodic stitcher — equal-power seam bit-math against a
+     monolithic full-buffer reference, bounded memory (numpy only);
+  3. service orchestration — deadline-sharing chunk groups, bounded
+     in-flight depth, ring->chunked degradation via the
+     ``longform_ring_error`` fault kind (fake backend, no jax);
+  4. router semantics — a chapter group's deadline_ms override in the
+     EDF heap under contention, and the max_deadline_ms clamp;
+  5. tiny-model e2e — HTTP structured 413 with the /synthesize/longform
+     pointer, the chunked endpoint end-to-end, and the ring tier
+     matching the unsharded dense free-run with zero steady-state
+     compiles (real jax, 2-way seq mesh on the forced-8-device CPU).
+"""
+
+import dataclasses
+import http.client
+import json
+import threading
+import time
+from collections import deque
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from speakingstyle_tpu.configs.config import (
+    Config,
+    FleetConfig,
+    LongformConfig,
+    ModelConfig,
+    ReferenceEncoderConfig,
+    ServeConfig,
+    StyleConfig,
+    TransformerConfig,
+    VarianceEmbeddingConfig,
+    VariancePredictorConfig,
+)
+from speakingstyle_tpu.faults import FaultPlan
+from speakingstyle_tpu.obs import JsonlEventLog, MetricsRegistry, read_events
+from speakingstyle_tpu.serving.engine import SynthesisRequest
+from speakingstyle_tpu.serving.lattice import RequestTooLarge
+from speakingstyle_tpu.serving.longform import (
+    LongformService,
+    Stitcher,
+    plan_chunks,
+    split_sentences,
+)
+
+# ---------------------------------------------------------------------------
+# chapter chunker (no jax)
+# ---------------------------------------------------------------------------
+
+
+def _enc(ids_per_word=3):
+    """Deterministic fake G2P: every whitespace word costs ``ids_per_word``
+    phonemes, values derived from the text so repeats are detectable."""
+    def encode(text):
+        n = len(text.split()) * ids_per_word
+        return (np.arange(n, dtype=np.int32) % 61) + 1
+    return encode
+
+
+def test_split_sentences_unicode_and_punct():
+    text = "こんにちは。\n今日は良い天気です。 Bonjour! Ça va? Fin…  ok."
+    assert split_sentences(text) == [
+        "こんにちは。", "今日は良い天気です。", "Bonjour!", "Ça va?",
+        "Fin…", "ok.",
+    ]
+    # no sentence-final punctuation: one sentence (plan_chunks hard-splits)
+    assert split_sentences("no punctuation at all") == \
+        ["no punctuation at all"]
+    assert split_sentences("") == []
+    assert split_sentences("   \n\t ") == []
+
+
+def test_plan_chunks_deterministic_and_exactly_packed():
+    text = " ".join(f"alpha beta s{i}." for i in range(7))  # 9 ids/sentence
+    a = plan_chunks(text, _enc(), max_phonemes=20)
+    b = plan_chunks(text, _enc(), max_phonemes=20)
+    assert len(a) == len(b) >= 2
+    for ca, cb in zip(a, b):
+        assert ca.index == cb.index and ca.text == cb.text
+        np.testing.assert_array_equal(ca.sequence, cb.sequence)
+    # exact packing: chunk sequences ARE the concatenated sentence
+    # sequences — nothing re-estimated, nothing lost
+    whole = np.concatenate(
+        [_enc()(s) for s in split_sentences(text)]
+    )
+    np.testing.assert_array_equal(
+        np.concatenate([c.sequence for c in a]), whole
+    )
+    for c in a:
+        assert 0 < c.sequence.size <= 20
+        assert c.sequence.dtype == np.int32
+    # greedy: every chunk but the last could not absorb the next sentence
+    for c, nxt in zip(a, a[1:]):
+        first_sent_ids = 9  # every sentence is 3 words
+        assert c.sequence.size + first_sent_ids > 20
+
+
+def test_plan_chunks_one_giant_sentence_hard_splits():
+    seq = np.arange(1, 38, dtype=np.int32)  # 37 ids, no boundary to cut
+    chunks = plan_chunks("one giant sentence no punct",
+                         lambda s: seq, max_phonemes=10)
+    assert [c.sequence.size for c in chunks] == [10, 10, 10, 7]
+    np.testing.assert_array_equal(
+        np.concatenate([c.sequence for c in chunks]), seq
+    )
+    assert [c.index for c in chunks] == [0, 1, 2, 3]
+
+
+def test_plan_chunks_empty_and_unencodable_text():
+    assert plan_chunks("", _enc(), 10) == []
+    assert plan_chunks("  \n ", _enc(), 10) == []
+    # encoder yields nothing (e.g. punctuation-only sentences)
+    assert plan_chunks("... ...", lambda s: np.empty(0, np.int32), 10) == []
+    with pytest.raises(ValueError):
+        plan_chunks("x", _enc(), 0)
+
+
+def test_plan_chunks_admission_cap_raises_413():
+    text = " ".join(f"w{i}." for i in range(30))  # 30 sentences, 3 ids each
+    with pytest.raises(RequestTooLarge, match="max_chunks"):
+        plan_chunks(text, _enc(), max_phonemes=3, max_chunks=8)
+    # uncapped plans fine
+    assert len(plan_chunks(text, _enc(), max_phonemes=3)) == 30
+
+
+# ---------------------------------------------------------------------------
+# prosodic stitcher (numpy only)
+# ---------------------------------------------------------------------------
+
+
+def _reference_stitch(wavs, fade):
+    """Monolithic full-buffer crossfade: the O(chapter)-memory math the
+    streaming Stitcher must reproduce bit-for-bit."""
+    out = np.asarray(wavs[0], np.int16)
+    for w in wavs[1:]:
+        w = np.asarray(w, np.int16)
+        f = min(fade, out.size, w.size)
+        if f > 0:
+            th = (np.arange(f, dtype=np.float32) + 0.5) * (np.pi / (2 * f))
+            mixed = np.clip(
+                out[-f:].astype(np.float32) * np.cos(th)
+                + w[:f].astype(np.float32) * np.sin(th),
+                -32768, 32767,
+            ).astype(np.int16)
+            out = np.concatenate([out[:-f], mixed, w[f:]])
+        else:
+            out = np.concatenate([out, w])
+    return out
+
+
+def test_stitcher_matches_monolithic_reference_bit_exactly():
+    rng = np.random.default_rng(7)
+    fade = 16
+    wavs = [
+        rng.integers(-20000, 20000, int(n)).astype(np.int16)
+        for n in rng.integers(3 * fade, 120, 5)
+    ]
+    st = Stitcher(fade)
+    pieces = []
+    for w in wavs:
+        pieces.extend(st.feed(w))
+    pieces.extend(st.finish())
+    got = np.concatenate(pieces)
+    np.testing.assert_array_equal(got, _reference_stitch(wavs, fade))
+    # one crossfade per seam: total length shrinks by fade per join
+    assert got.size == sum(w.size for w in wavs) - (len(wavs) - 1) * fade
+    # every seam metered
+    assert len(st.seam_rms) == len(wavs) - 1
+    assert all(np.isfinite(r) and r >= 0 for r in st.seam_rms)
+
+
+def test_stitcher_fade_zero_is_a_metered_butt_joint():
+    rng = np.random.default_rng(1)
+    wavs = [rng.integers(-100, 100, 40).astype(np.int16) for _ in range(3)]
+    st = Stitcher(0)
+    pieces = []
+    for w in wavs:
+        pieces.extend(st.feed(w))
+    pieces.extend(st.finish())
+    np.testing.assert_array_equal(np.concatenate(pieces),
+                                  np.concatenate(wavs))
+    assert len(st.seam_rms) == 2  # seams still observed (click detector)
+
+
+def test_stitcher_memory_is_bounded_by_the_fade():
+    fade = 8
+    st = Stitcher(fade)
+    rng = np.random.default_rng(2)
+    for _ in range(50):
+        st.feed(rng.integers(-5, 5, 64).astype(np.int16))
+        assert st._tail is not None and st._tail.size <= fade
+    assert st.feed(np.empty(0, np.int16)) == []
+    with pytest.raises(ValueError):
+        Stitcher(-1)
+
+
+# ---------------------------------------------------------------------------
+# service orchestration (fake backend — no jax)
+# ---------------------------------------------------------------------------
+
+
+class _FakeFrontend:
+    """3 phoneme ids per word; no style; numeric speakers."""
+
+    def sequence(self, text):
+        return _enc()(text)
+
+    def resolve_style(self, payload):
+        return None, None, False
+
+    def speaker(self, spec):
+        return int(spec)
+
+
+class _FakeBackend:
+    """submit() hands back lazily-resolving futures with deterministic
+    wavs, and records the high-water mark of uncollected futures — the
+    bounded-memory observable."""
+
+    def __init__(self):
+        self.requests = []
+        self.outstanding = 0
+        self.max_outstanding = 0
+        self.cancelled = 0
+
+    def submit(self, req):
+        self.requests.append(req)
+        self.outstanding += 1
+        self.max_outstanding = max(self.max_outstanding, self.outstanding)
+        backend = self
+        rng = np.random.default_rng(req.sequence.size + len(self.requests))
+        wav = rng.integers(-3000, 3000, req.sequence.size * 4).astype(np.int16)
+
+        class _Fut:
+            def result(self, timeout=None):
+                backend.outstanding -= 1
+                return SimpleNamespace(id=req.id, wav=wav)
+
+            def cancel(self):
+                backend.cancelled += 1
+                return True
+
+        return _Fut()
+
+
+def _svc_cfg(**lf_kw):
+    lf = dict(crossfade_frames=0, group_depth=2, max_chunks=16,
+              deadline_ms_per_chunk=30_000.0)
+    lf.update(lf_kw)
+    return Config(serve=ServeConfig(
+        batch_buckets=[1, 2], src_buckets=[16], mel_buckets=[64],
+        frames_per_phoneme=2, longform=LongformConfig(**lf),
+    ))
+
+
+def _chapter(n_sent=6):
+    # each sentence = 4 words = 12 ids; cap 16 -> one sentence per chunk
+    return {"text": " ".join(f"alpha beta gamma s{i}." for i in range(n_sent))}
+
+
+def test_service_admission_plans_a_deadline_sharing_group(tmp_path):
+    reg = MetricsRegistry()
+    be = _FakeBackend()
+    svc = LongformService(_svc_cfg(), _FakeFrontend(), be, registry=reg,
+                          events=JsonlEventLog(str(tmp_path)))
+    assert svc.chunk_phoneme_cap == 16  # min(src 16, mel 64 / fpp 2 = 32)
+    plan = svc.admit("lf1", _chapter(6))
+    assert plan.tier == "chunked" and len(plan.chunks) == 6
+    assert plan.total_phonemes == 72
+    # 6 * 30s = 180s exceeds fleet.max_deadline_ms -> clamped group budget
+    assert plan.deadline_ms == 120_000.0
+    assert svc.admit("lf2", _chapter(2)).deadline_ms == 60_000.0
+    wav = np.concatenate(list(svc.stream(plan)))
+    # every chunk request carries the chapter's identity: same arrival,
+    # same shared deadline override, the long-form class, ordered ids
+    assert [r.id for r in be.requests] == [f"lf1.c{i:03d}" for i in range(6)]
+    assert all(r.priority == "batch" for r in be.requests)
+    assert all(r.arrival == plan.arrival for r in be.requests)
+    assert all(r.deadline_ms == plan.deadline_ms for r in be.requests)
+    assert wav.size == 72 * 4  # crossfade 0: nothing trimmed
+    assert reg.value("serve_longform_requests_total",
+                     {"tier": "chunked"}) == 2.0
+    assert reg.value("serve_longform_chunks_total") == 6.0
+    names = [r["event"] for r in read_events(str(tmp_path))]
+    assert names == ["longform_admit", "longform_admit", "longform_done"]
+
+
+def test_service_in_flight_depth_is_bounded(tmp_path):
+    be = _FakeBackend()
+    svc = LongformService(_svc_cfg(group_depth=2), _FakeFrontend(), be,
+                          registry=MetricsRegistry())
+    plan = svc.admit("lf1", _chapter(7))
+    assert len(plan.chunks) == 7
+    for _ in svc.stream(plan):
+        pass
+    # never more than group_depth chunk futures ahead of the stitch point
+    assert be.max_outstanding == 2
+
+
+def test_service_abandoned_stream_cancels_pending_chunks():
+    be = _FakeBackend()
+    svc = LongformService(_svc_cfg(group_depth=3), _FakeFrontend(), be,
+                          registry=MetricsRegistry())
+    gen = svc.stream(svc.admit("lf1", _chapter(6)))
+    next(gen)       # first stitched piece: group_depth futures in flight
+    gen.close()     # consumer hangs up mid-chapter
+    assert be.cancelled >= 1
+    assert len(be.requests) < 6  # the tail of the chapter was never sent
+
+
+def test_service_ring_failure_degrades_to_chunked(tmp_path):
+    reg = MetricsRegistry()
+    be = _FakeBackend()
+    svc = LongformService(
+        _svc_cfg(), _FakeFrontend(), be,
+        engine=SimpleNamespace(vocoder=("gen", "params")),
+        ring=SimpleNamespace(max_src=10_000, max_mel=100_000),
+        fault_plan=FaultPlan.parse("longform_ring_error@1"),
+        registry=reg, events=JsonlEventLog(str(tmp_path)),
+    )
+    plan = svc.admit("lf1", _chapter(4))
+    assert plan.tier == "ring"  # fits the (stub) ring lattice
+    wav = np.concatenate(list(svc.stream(plan)))
+    # PR 9 contract: the injected ring fault costs one degradation, not
+    # the request — the chapter completes on the chunked tier
+    assert plan.tier == "chunked"
+    assert wav.size == plan.total_phonemes * 4
+    assert len(be.requests) == 4
+    assert reg.value("serve_longform_degraded_total") == 1.0
+    assert reg.value("serve_longform_requests_total", {"tier": "ring"}) == 1.0
+    assert reg.value("serve_longform_requests_total",
+                     {"tier": "chunked"}) == 1.0
+    names = [r["event"] for r in read_events(str(tmp_path))]
+    assert names == ["longform_admit", "longform_degraded", "longform_done"]
+    assert svc.fault_plan.pending() == []  # fired exactly once
+
+
+def test_service_admission_validation():
+    svc = LongformService(_svc_cfg(), _FakeFrontend(), _FakeBackend(),
+                          registry=MetricsRegistry())
+    with pytest.raises(ValueError, match="text"):
+        svc.admit("x", {})
+    with pytest.raises(ValueError, match="tier"):
+        svc.admit("x", {"text": "hi there.", "tier": "warp"})
+    with pytest.raises(ValueError, match="scalar"):
+        svc.admit("x", {"text": "hi there.",
+                        "duration_control": [1.0, 2.0]})
+    with pytest.raises(RequestTooLarge):
+        svc.admit("x", _chapter(40))  # 40 chunks > max_chunks=16
+    # no ring attached: forcing tier=ring still admits as chunked
+    assert svc.admit(
+        "x", {"text": "hi there.", "tier": "ring"}
+    ).tier == "chunked"
+
+
+# ---------------------------------------------------------------------------
+# router semantics: the deadline_ms override in the EDF heap (no jax)
+# ---------------------------------------------------------------------------
+
+
+def _fleet_cfg(**fleet_kw):
+    fleet = dict(queue_depth=32)
+    fleet.update(fleet_kw)
+    return Config(serve=ServeConfig(
+        batch_buckets=[1], src_buckets=[16], mel_buckets=[64],
+        frames_per_phoneme=2, max_wait_ms=5.0,
+        fleet=FleetConfig(**fleet),
+    ))
+
+
+class _GatedEngine:
+    """Replica stand-in: records dispatch order, gate blocks the first."""
+
+    def __init__(self, gate):
+        self.dispatches = []
+        self.gate = gate
+        self.entered = threading.Event()
+        self._first = True
+        self.lock = threading.Lock()
+
+    def precompile(self):
+        return 0.0
+
+    def run(self, requests):
+        if self._first:
+            self._first = False
+            self.entered.set()
+            self.gate.wait(timeout=10)
+        with self.lock:
+            self.dispatches.extend(r.id for r in requests)
+        return [SimpleNamespace(id=r.id, bucket=None, mel_len=1)
+                for r in requests]
+
+
+def _rreq(rid, **kw):
+    return SynthesisRequest(
+        id=rid, sequence=np.ones(8, np.int32),
+        ref_mel=np.zeros((4, 80), np.float32), **kw,
+    )
+
+
+def test_chapter_group_rides_the_edf_heap_as_one_late_unit():
+    """Chunks sharing one arrival + one deadline_ms override sort after
+    plain batch work (their budget is the CHAPTER's, not the class's)
+    and keep their submission order among themselves."""
+    from speakingstyle_tpu.serving.fleet import FleetRouter
+
+    gate = threading.Event()
+    eng = _GatedEngine(gate)
+    router = FleetRouter(lambda reg: eng, _fleet_cfg(), replicas=1)
+    assert router.wait_ready(timeout=10)
+    futs = [router.submit(_rreq("r0"))]          # occupies the worker
+    assert eng.entered.wait(timeout=10)
+    t0 = time.monotonic()
+    # a 2-chunk chapter group (50 s shared budget), then ordinary traffic
+    for c in ("lf.c000", "lf.c001"):
+        futs.append(router.submit(_rreq(
+            c, priority="batch", arrival=t0, deadline_ms=50_000.0)))
+    futs.append(router.submit(_rreq("b1", priority="batch")))
+    futs.append(router.submit(_rreq("i1", priority="interactive")))
+    gate.set()
+    for f in futs:
+        f.result(timeout=10)
+    router.close()
+    # EDF: interactive (250 ms) < batch (2 s) < the chapter group (50 s);
+    # FIFO inside the group — the stitcher needs chunks in order
+    assert eng.dispatches == ["r0", "i1", "b1", "lf.c000", "lf.c001"]
+
+
+def test_deadline_override_is_clamped_and_validated():
+    from speakingstyle_tpu.serving.fleet import FleetRouter
+
+    cfg = _fleet_cfg(max_deadline_ms=90_000.0)
+    router = FleetRouter(lambda reg: _GatedEngine(threading.Event()),
+                         cfg, replicas=1)
+    try:
+        # no override: the class budget
+        assert router._budget_s(_rreq("a"), "interactive") == 0.25
+        # override below the ceiling: taken verbatim
+        assert router._budget_s(
+            _rreq("b", deadline_ms=500.0), "batch") == 0.5
+        # a client cannot park an entry in the heap forever
+        assert router._budget_s(
+            _rreq("c", deadline_ms=1e9), "batch") == 90.0
+        with pytest.raises(ValueError, match="deadline_ms"):
+            router.submit(_rreq("d", deadline_ms=-1.0))
+    finally:
+        router.close(flush=False)
+    # the ceiling must admit every class budget
+    with pytest.raises(ValueError, match="max_deadline_ms"):
+        FleetConfig(max_deadline_ms=100.0)  # < batch's 2000 ms
+
+
+# ---------------------------------------------------------------------------
+# tiny-model e2e: HTTP 413 pointer, chunked endpoint, ring tier (real jax)
+# ---------------------------------------------------------------------------
+
+
+def _tiny_cfg():
+    return Config(
+        model=ModelConfig(
+            transformer=TransformerConfig(
+                encoder_layer=1, decoder_layer=1, encoder_hidden=16,
+                decoder_hidden=16, conv_filter_size=16,
+                conv_kernel_size=(3, 1),
+            ),
+            reference_encoder=ReferenceEncoderConfig(
+                encoder_layer=1, encoder_head=2, encoder_hidden=16,
+                conv_layer=1, conv_filter_size=16,
+            ),
+            variance_predictor=VariancePredictorConfig(filter_size=16),
+            variance_embedding=VarianceEmbeddingConfig(n_bins=8),
+            postnet_embedding_dim=16, postnet_layers=2,
+            max_seq_len=48, compute_dtype="float32",
+        ),
+        serve=ServeConfig(
+            batch_buckets=[1, 2], src_buckets=[16], mel_buckets=[32],
+            frames_per_phoneme=2, max_wait_ms=20.0,
+            style=StyleConfig(ref_buckets=[32]),
+            longform=LongformConfig(
+                crossfade_frames=1, group_depth=2, max_chunks=32,
+                deadline_ms_per_chunk=30_000.0,
+            ),
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def tiny_serve():
+    """(cfg, variables, engine): one precompiled tiny engine shared by
+    the e2e tests (AOT precompile is the expensive part)."""
+    import jax
+
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.models.hifigan import Generator
+    from speakingstyle_tpu.serving.engine import SynthesisEngine
+
+    cfg = _tiny_cfg()
+    model = build_model(cfg, n_position=49)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    # bias the duration predictor so random weights predict ~2 frames
+    # per phoneme — real (nonzero) audio flows end-to-end
+    bias = variables["params"]["variance_adaptor"]["duration_predictor"][
+        "linear_layer"]["bias"]
+    variables["params"]["variance_adaptor"]["duration_predictor"][
+        "linear_layer"]["bias"] = bias + 1.1
+    gen = Generator(
+        upsample_rates=(2, 2), upsample_kernel_sizes=(4, 4),
+        upsample_initial_channel=16, resblock_kernel_sizes=(3,),
+        resblock_dilation_sizes=((1,),),
+    )
+    gparams = gen.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8, 80), np.float32)
+    )["params"]
+    engine = SynthesisEngine(cfg, variables, vocoder=(gen, gparams),
+                             model=model)
+    engine.precompile()
+    return cfg, variables, engine
+
+
+@pytest.fixture(scope="module")
+def ring_tier(tiny_serve):
+    """A 2-way seq-mesh ring tier over the tiny model's weights, at one
+    dedicated long-form bucket (32 phonemes / 64 mel frames)."""
+    from speakingstyle_tpu.serving.longform import RingTier
+
+    cfg, variables, engine = tiny_serve
+    cfg_lf = dataclasses.replace(cfg, serve=dataclasses.replace(
+        cfg.serve, longform=LongformConfig(
+            mesh_seq=2, src_buckets=[32], mel_buckets=[64],
+            crossfade_frames=1, deadline_ms_per_chunk=30_000.0,
+        ),
+    ))
+    ring = RingTier(cfg_lf, variables, engine)
+    ring.precompile()
+    return ring
+
+
+def _http(server):
+    host, port = server.address[:2]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return http.client.HTTPConnection(host, port, timeout=60)
+
+
+def test_http_too_large_is_a_structured_413_with_longform_pointer(tiny_serve):
+    from speakingstyle_tpu.serving.server import SynthesisServer, TextFrontend
+
+    cfg, _, engine = tiny_serve
+    ref = np.random.default_rng(0).standard_normal((20, 80)).astype(np.float32)
+    server = SynthesisServer(engine, TextFrontend(cfg, ref),
+                             host="127.0.0.1", port=0)
+    try:
+        conn = _http(server)
+        # far past the 16-phoneme lattice ceiling
+        conn.request("POST", "/synthesize", body=json.dumps(
+            {"text": "the quick brown fox jumps over the lazy dog "
+                     "again and again while twenty tired turtles "
+                     "slowly carry seven shiny stones home"}
+        ))
+        resp = conn.getresponse()
+        body = json.loads(resp.read())
+        assert resp.status == 413
+        assert body["max_src"] == 16 and body["max_mel"] == 32
+        assert body["max_phonemes"] == 16  # min(src 16, mel 32 / fpp 2)
+        assert body["longform"] == "/synthesize/longform"
+        assert resp.getheader("X-Request-Id")
+        conn.close()
+    finally:
+        server.shutdown()
+
+
+def test_http_longform_chunked_end_to_end(tiny_serve):
+    from speakingstyle_tpu.serving.engine import CompileMonitor
+    from speakingstyle_tpu.serving.server import SynthesisServer, TextFrontend
+
+    cfg, _, engine = tiny_serve
+    ref = np.random.default_rng(0).standard_normal((20, 80)).astype(np.float32)
+    server = SynthesisServer(engine, TextFrontend(cfg, ref),
+                             host="127.0.0.1", port=0)
+    assert server.longform is not None  # auto-built with the frontend
+    text = ("The quick brown fox jumps over the lazy dog. "
+            "Pack my box with five dozen liquor jugs. "
+            "How vexingly quick daft zebras jump!")
+    try:
+        conn = _http(server)
+        with CompileMonitor() as mon:
+            conn.request("POST", "/synthesize/longform",
+                         body=json.dumps({"text": text}))
+            resp = conn.getresponse()
+            body = resp.read()
+        assert resp.status == 200, body
+        assert resp.getheader("Content-Type") == "audio/wav"
+        assert resp.getheader("X-Longform-Tier") == "chunked"
+        assert int(resp.getheader("X-Longform-Chunks")) >= 2
+        assert body[:4] == b"RIFF" and body[8:12] == b"WAVE"
+        assert len(body) > 44  # header + stitched audio
+        # the acceptance invariant holds through the chapter path: every
+        # chunk rode a precompiled interactive bucket
+        assert mon.count == 0, "long-form synthesis compiled in steady state"
+
+        # malformed chapter -> structured 400, server stays up
+        conn.request("POST", "/synthesize/longform", body=json.dumps({}))
+        resp = conn.getresponse()
+        assert resp.status == 400 and b"text" in resp.read()
+        conn.close()
+    finally:
+        server.shutdown()
+
+
+def test_ring_tier_matches_dense_free_run_zero_steady_state_compiles(
+        tiny_serve, ring_tier):
+    """Tier (b) correctness: the 2-way ring-attention chapter free-run
+    reproduces the unsharded dense model at the same padded geometry,
+    and repeat chapters execute with ZERO compiles."""
+    import jax
+
+    from speakingstyle_tpu.models.factory import build_model
+    from speakingstyle_tpu.serving.engine import CompileMonitor
+
+    cfg, variables, engine = tiny_serve
+    ring = ring_tier
+    rng = np.random.default_rng(3)
+    n = 24  # past the interactive src bucket (16), inside the ring's 32
+    seq = rng.integers(1, 300, n).astype(np.int32)
+    ref = rng.standard_normal((20, 80)).astype(np.float32)
+    sv = engine.style.encode_mels([ref])[0]
+
+    req = SynthesisRequest(id="ch0", sequence=seq, ref_mel=None, style=sv)
+    result = ring.synthesize(req)
+    assert result.bucket.l_src == 32 and result.bucket.t_mel == 64
+    assert 0 < result.mel_len <= 64
+    assert result.mel.shape == (result.mel_len, 80)
+    assert result.wav is None  # mel-only: the vocoder streams it
+
+    # unsharded dense reference at the identical padded geometry
+    dense = build_model(cfg, n_position=ring.lattice.max_mel + 1)
+    texts = np.zeros((1, 32), np.int32)
+    texts[0, :n] = seq
+    out = dense.apply(
+        variables,
+        speakers=np.zeros((1,), np.int32),
+        texts=texts,
+        src_lens=np.asarray([n], np.int32),
+        mels=None, mel_lens=None, max_mel_len=64,
+        p_control=np.ones((1, 32), np.float32),
+        e_control=np.ones((1, 32), np.float32),
+        d_control=np.ones((1, 32), np.float32),
+        gammas=sv.gamma.reshape(1, 1, -1),
+        betas=sv.beta.reshape(1, 1, -1),
+        deterministic=True,
+    )
+    assert int(np.asarray(out["mel_lens"])[0]) == result.mel_len
+    np.testing.assert_allclose(
+        result.mel, np.asarray(out["mel_postnet"])[0, :result.mel_len],
+        atol=2e-4,
+    )
+
+    # steady state: a second chapter reuses the ring program
+    with CompileMonitor() as mon:
+        again = ring.synthesize(
+            SynthesisRequest(id="ch1", sequence=seq, ref_mel=None, style=sv)
+        )
+    assert mon.count == 0, "ring tier compiled in steady state"
+    np.testing.assert_allclose(again.mel, result.mel, atol=1e-5)
+
+    # the compile minted a ProgramCard on the shared registry
+    card = engine.program_registry.card("acoustic_ring:b1.s32.m64")
+    assert card is not None and card["flops"] > 0
+    assert card["label_kind"] == "acoustic_ring"
+    assert card["label_mesh"] == "seq2"
+
+
+def test_http_longform_ring_tier_selected_at_admission(tiny_serve, ring_tier):
+    """Attaching a ring tier (cli/serve.py style) flips small chapters
+    to tier (b) at admission; the response streams through the engine's
+    precompiled vocoder windows and names its tier."""
+    from speakingstyle_tpu.serving.server import SynthesisServer, TextFrontend
+
+    cfg, _, engine = tiny_serve
+    ref = np.random.default_rng(0).standard_normal((20, 80)).astype(np.float32)
+    server = SynthesisServer(engine, TextFrontend(cfg, ref),
+                             host="127.0.0.1", port=0)
+    server.longform.ring = ring_tier
+    try:
+        conn = _http(server)
+        conn.request("POST", "/synthesize/longform",
+                     body=json.dumps({"text": "Hello there friend."}))
+        resp = conn.getresponse()
+        body = resp.read()
+        assert resp.status == 200, body
+        assert resp.getheader("X-Longform-Tier") == "ring"
+        assert body[:4] == b"RIFF" and len(body) > 44
+        conn.close()
+    finally:
+        server.shutdown()
