@@ -1427,6 +1427,34 @@ def run_fleet(duration: float = 3.0, replica_counts=(1, 2, 4),
     return scaling
 
 
+def _lock_witness_stats():
+    """Lock-witness numbers for a drill point, or empties when
+    SPEAKINGSTYLE_CHECKS is off.  TrackedLock exports to the
+    process-global registry (not the drill's own), so read from there:
+    max p999 hold across every tracked lock + the inversion count (the
+    drill invariant: ZERO — an inversion also raises in-line, so a
+    nonzero count here means a worker thread died on it)."""
+    from speakingstyle_tpu.obs.locks import checks_enabled
+    from speakingstyle_tpu.obs.registry import get_registry
+
+    if not checks_enabled():
+        return {"lock_hold_p999_max_s": None, "lock_order_inversions": None}
+    reg = get_registry()
+    p999s = [
+        h.percentile(0.999)
+        for h in reg.metrics_named("lock_hold_seconds")
+        if h.count
+    ]
+    return {
+        "lock_hold_p999_max_s": (
+            round(max(p999s), 6) if p999s else None
+        ),
+        "lock_order_inversions": int(
+            reg.value("lock_order_inversions_total")
+        ),
+    }
+
+
 def run_chaos(duration: float = 3.0, clients: int = 16,
               device_ms: float = 20.0):
     """Chaos drill: kill one of two replicas at a deterministic dispatch
@@ -1678,6 +1706,7 @@ def run_chaos(duration: float = 3.0, clients: int = 16,
         "recovered": recovered,
         "proxy_device_ms": device_ms,
         "model": label,
+        **_lock_witness_stats(),
     }
     print(json.dumps(point))
     return point
@@ -2331,6 +2360,7 @@ def run_traffic(duration: float = 4.0, base_qps: float = 12.0,
         "longform_chunks": int(registry.value("serve_longform_chunks_total")),
         "proxy_device_ms": device_ms,
         "model": label,
+        **_lock_witness_stats(),
     }
     print(json.dumps(point))
     return point
@@ -2998,6 +3028,15 @@ def _absorb_record(rec, metrics):
                                               "lower")
         if isinstance(rec.get("shed"), (int, float)):
             metrics["chaos_shed"] = (float(rec["shed"]), "lower")
+        # lock-witness numbers (present when the drill ran with
+        # SPEAKINGSTYLE_CHECKS=1): hold p999 bounds critical-section
+        # length; inversions carry a hard zero expectation
+        if isinstance(rec.get("lock_hold_p999_max_s"), (int, float)):
+            metrics["chaos_lock_hold_p999_max_s"] = (
+                float(rec["lock_hold_p999_max_s"]), "lower")
+        if isinstance(rec.get("lock_order_inversions"), (int, float)):
+            metrics["chaos_lock_order_inversions"] = (
+                float(rec["lock_order_inversions"]), "lower")
     elif m == "serve_rollout":
         # the live-upgrade drill; rollout_lost_requests carries the same
         # hard zero gate as chaos/traffic in run_compare — an upgrade
@@ -3027,6 +3066,12 @@ def _absorb_record(rec, metrics):
         if isinstance(rec.get("steady_compiles"), (int, float)):
             metrics["traffic_steady_compiles"] = (
                 float(rec["steady_compiles"]), "lower")
+        if isinstance(rec.get("lock_hold_p999_max_s"), (int, float)):
+            metrics["traffic_lock_hold_p999_max_s"] = (
+                float(rec["lock_hold_p999_max_s"]), "lower")
+        if isinstance(rec.get("lock_order_inversions"), (int, float)):
+            metrics["traffic_lock_order_inversions"] = (
+                float(rec["lock_order_inversions"]), "lower")
     elif m == "serve_longform":
         # chapter synthesis on both tiers; the compile counts ride as
         # lower-is-better (floor and expected value: zero), seam_rms_max

@@ -1,0 +1,1011 @@
+"""Class-concurrency model: the shared dataflow core under JL020–JL023.
+
+One pass over a module's AST produces, per class:
+
+  * **lock attributes** — ``self._x = threading.Lock()/RLock()/
+    Condition()`` (or the ``obs.locks.make_lock(...)`` wrapper) assigned
+    in ``__init__``, named ``"ClassName._x"`` everywhere downstream so
+    the static model, the checked-in ``lockorder.json``, and the runtime
+    ``TrackedLock`` witness all speak about the same object;
+  * **attribute kinds** — Events, queues, ``obs.registry`` metrics, and
+    plain state, because the first three are the JL020 exemption list
+    (their thread-safety is internal to the object);
+  * **thread-reachable methods** — entry points handed to
+    ``threading.Thread(target=...)``, ``threading.Timer``, or an
+    executor ``.submit``, closed transitively over ``self.method()``
+    calls;
+  * **guarded-by classification** — every attribute read/write site
+    carries the set of locks lexically held around it (``with
+    self._lock:`` scope tracking), widened by one level of helper-method
+    call-through: a private helper whose every intra-class call site
+    holds L is analyzed as if L were held at entry (the fleet's
+    ``_set_state`` / ``_check_shed`` "caller must hold" idiom).
+
+Attribute sites are also resolved through *local* receivers: inside
+``FleetRouter`` methods, ``rep.state`` binds to the ``Replica`` class
+when exactly one class in the module declares ``state`` in its
+``__init__`` — that is how replica-lifecycle fields guarded by the
+router's condition variable are modeled even though ``Replica`` itself
+has no methods.
+
+Lock-order edges (JL022 / ``lockorder.json``) come from three shapes:
+lexical ``with`` nesting, self-method call-through (holding L while
+calling a helper that acquires M), and cross-class call-through
+(holding L while calling a method on an attribute whose class is known
+— e.g. the fleet holding ``_cond`` while ``drain_rate.retry_after()``
+takes the estimator's lock).  Attribute classes resolve from direct
+constructor assignment (``self.x = DrainRateEstimator()``), from a
+constructor call anywhere in the RHS expression, or from
+constructor-argument passthrough (``self.x = param`` where some call
+site passes ``ClassName(...)`` for that parameter).
+
+``build_lockorder`` merges every module's model into one program-wide
+graph and emits the total order the runtime witness enforces.
+"""
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterator, List, Optional, Set, Tuple
+
+__all__ = [
+    "AccessSite",
+    "Acquisition",
+    "BlockingCall",
+    "MethodModel",
+    "ClassModel",
+    "ModuleConcurrency",
+    "module_model",
+    "merge_models",
+    "lock_edges",
+    "find_cycle",
+    "topological_order",
+    "tree_models",
+    "lockorder_artifact",
+]
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    elif isinstance(cur, ast.Call):
+        inner = _dotted(cur.func)
+        parts.append(f"{inner}()" if inner else "()")
+    return ".".join(reversed(parts))
+
+
+# constructor spellings -> attribute kind
+_LOCK_CTORS = {
+    "threading.Lock": "lock",
+    "threading.RLock": "rlock",
+    "threading.Condition": "condition",
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+}
+_EVENT_CTORS = {"threading.Event", "Event"}
+_QUEUE_CTORS = {
+    "queue.Queue": "queue",
+    "queue.SimpleQueue": "simplequeue",
+    "queue.LifoQueue": "queue",
+    "queue.PriorityQueue": "queue",
+    "Queue": "queue",
+    "SimpleQueue": "simplequeue",
+}
+_METRIC_FACTORIES = {"counter", "gauge", "histogram"}
+# self.X.m(...) where m mutates the container/state behind X counts as a
+# write site of X (the heap/dict/deque mutation idiom)
+_MUTATOR_METHODS = {
+    "append", "appendleft", "extend", "insert", "add", "update",
+    "setdefault", "pop", "popitem", "popleft", "remove", "discard",
+    "clear", "push",
+}
+# blocking-call surface for JL021 (spellings, not types — this is AST)
+_BLOCKING_DOTTED_PREFIXES = ("subprocess.", "socket.", "requests.")
+_BLOCKING_DOTTED = {"urllib.request.urlopen", "urlopen", "time.sleep"}
+_BLOCKING_SOCKET_METHODS = {"sendall", "recv", "connect", "accept"}
+
+
+@dataclass(frozen=True)
+class AccessSite:
+    """One attribute read/write, resolved to the class that declares it."""
+
+    owner: str          # declaring class name
+    attr: str
+    method: str         # qualname of the method containing the site
+    lineno: int
+    is_write: bool
+    locks: FrozenSet[str]   # lock names lexically held (pre call-through)
+
+
+@dataclass(frozen=True)
+class Acquisition:
+    """``with self._x:`` on a recognized lock attribute."""
+
+    lock: str               # "ClassName._x"
+    held: Tuple[str, ...]   # locks already held, acquisition order
+    lineno: int
+    method: str
+
+
+@dataclass(frozen=True)
+class BlockingCall:
+    desc: str
+    locks: Tuple[str, ...]
+    lineno: int
+    method: str
+
+
+@dataclass
+class MethodModel:
+    name: str
+    qualname: str
+    lineno: int
+    sites: List[AccessSite] = field(default_factory=list)
+    acquisitions: List[Acquisition] = field(default_factory=list)
+    blocking: List[BlockingCall] = field(default_factory=list)
+    # (callee method name, locks held at the call, lineno)
+    self_calls: List[Tuple[str, Tuple[str, ...], int]] = field(
+        default_factory=list
+    )
+    # (receiver attr name, receiver owner class, callee, locks, lineno)
+    attr_calls: List[Tuple[str, str, str, Tuple[str, ...], int]] = field(
+        default_factory=list
+    )
+    # (local receiver name, callee, locks held, lineno) — ``t.join()``
+    local_calls: List[Tuple[str, str, Tuple[str, ...], int]] = field(
+        default_factory=list
+    )
+    entry_locks: FrozenSet[str] = frozenset()
+    # locks this method holds via the explicit ``self._x.acquire()`` ...
+    # ``finally: self._x.release()`` idiom (no lexical with-scope); the
+    # whole method body is conservatively treated as the critical
+    # section (folded into entry_locks at finalize)
+    manual_locks: FrozenSet[str] = frozenset()
+    thread_reachable: bool = False
+
+
+@dataclass
+class ClassModel:
+    name: str
+    lineno: int
+    lock_attrs: Dict[str, str] = field(default_factory=dict)   # attr -> kind
+    attr_kinds: Dict[str, str] = field(default_factory=dict)   # attr -> kind
+    attr_types: Dict[str, str] = field(default_factory=dict)   # attr -> class
+    init_attrs: Set[str] = field(default_factory=set)
+    param_attrs: Dict[str, str] = field(default_factory=dict)  # param -> attr
+    init_params: List[str] = field(default_factory=list)
+    methods: Dict[str, MethodModel] = field(default_factory=dict)
+    thread_entries: Set[str] = field(default_factory=set)
+    # (lineno, has name= kwarg, target method name or None, method qualname)
+    thread_sites: List[Tuple[int, bool, Optional[str], str]] = field(
+        default_factory=list
+    )
+
+    def lock_name(self, attr: str) -> str:
+        return f"{self.name}.{attr}"
+
+    @property
+    def creates_threads(self) -> bool:
+        return bool(self.thread_sites)
+
+    def effective_locks(self, site: AccessSite, method: MethodModel
+                        ) -> FrozenSet[str]:
+        return site.locks | method.entry_locks
+
+
+@dataclass
+class ModuleConcurrency:
+    path: str
+    classes: Dict[str, ClassModel] = field(default_factory=dict)
+    # attr name -> declaring class, when exactly one class declares it
+    unique_attr_owner: Dict[str, str] = field(default_factory=dict)
+    # module-level Thread() calls outside any class
+    module_thread_sites: List[Tuple[int, bool, Optional[str], str]] = field(
+        default_factory=list
+    )
+    # constructor-call shapes seen anywhere in the module:
+    # (ClassTail, [positional arg class tails], {kwarg: class tail})
+    ctor_calls: List[Tuple[str, List[Optional[str]], Dict[str, str]]] = \
+        field(default_factory=list)
+
+
+def _ctor_kind(expr: ast.AST) -> Optional[Tuple[str, str]]:
+    """(kind, detail) for the first recognized constructor call inside
+    ``expr`` — handles ``x if cond else Lock()`` shapes by scanning the
+    whole RHS expression."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func)
+        tail = callee.split(".")[-1]
+        if callee in _LOCK_CTORS:
+            return ("lock", _LOCK_CTORS[callee])
+        if tail == "make_lock":
+            kind = "lock"
+            for kw in node.keywords:
+                if kw.arg == "kind" and isinstance(kw.value, ast.Constant):
+                    kind = str(kw.value.value)
+            return ("lock", kind)
+        if callee in _EVENT_CTORS:
+            return ("event", "event")
+        if callee in _QUEUE_CTORS:
+            return ("queue", _QUEUE_CTORS[callee])
+        if tail in _METRIC_FACTORIES and "." in callee:
+            return ("metric", tail)
+    return None
+
+
+def _ctor_class(expr: ast.AST) -> Optional[str]:
+    """Class name constructed anywhere in ``expr`` (``Foo()`` /
+    ``pkg.Foo()``), or None.  Lock/queue/event constructors are not
+    classes of interest here."""
+    for node in ast.walk(expr):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _dotted(node.func)
+        tail = callee.split(".")[-1]
+        if not tail or not tail[0].isupper():
+            continue
+        if callee in _LOCK_CTORS or callee in _EVENT_CTORS \
+                or callee in _QUEUE_CTORS:
+            continue
+        return tail
+    return None
+
+
+def _thread_target(call: ast.Call) -> Optional[str]:
+    """Method name handed to Thread(target=...) / Timer(_, fn) /
+    .submit(fn, ...) — only ``self.m`` and bare-name targets resolve."""
+    callee = _dotted(call.func)
+    tgt: Optional[ast.AST] = None
+    if callee in ("threading.Thread", "Thread"):
+        for kw in call.keywords:
+            if kw.arg == "target":
+                tgt = kw.value
+    elif callee in ("threading.Timer", "Timer"):
+        if len(call.args) >= 2:
+            tgt = call.args[1]
+    elif isinstance(call.func, ast.Attribute) and call.func.attr == "submit":
+        if call.args:
+            tgt = call.args[0]
+    if tgt is None:
+        return None
+    if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) \
+            and tgt.value.id == "self":
+        return tgt.attr
+    if isinstance(tgt, ast.Name):
+        return tgt.id
+    return None
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    return _dotted(call.func) in ("threading.Thread", "Thread")
+
+
+class _MethodScanner:
+    """One lexical walk of a method body, tracking the with-held lock
+    set.  Nested function/class definitions are separate scopes and are
+    skipped (conservative: their sites are not attributed to the
+    method's lock context)."""
+
+    def __init__(self, cls: ClassModel, mm: MethodModel,
+                 imported: Set[str],
+                 ctor_calls: Optional[List] = None):
+        self.cls = cls
+        self.mm = mm
+        self.imported = imported
+        self.ctor_calls = ctor_calls if ctor_calls is not None else []
+        self._call_funcs: Set[int] = set()
+        # lock attrs seen in explicit self._x.acquire() / .release()
+        # calls; a pair makes the lock a method-scope manual_lock
+        self.manual_acq: Set[str] = set()
+        self.manual_rel: Set[str] = set()
+
+    def _self_lock(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and expr.attr in self.cls.lock_attrs:
+            return self.cls.lock_name(expr.attr)
+        return None
+
+    def scan(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self._visit(stmt, ())
+
+    def _visit(self, node: ast.AST, held: Tuple[str, ...]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                lock = self._self_lock(item.context_expr)
+                if lock is not None:
+                    self.mm.acquisitions.append(Acquisition(
+                        lock=lock, held=held, lineno=node.lineno,
+                        method=self.mm.qualname,
+                    ))
+                    held = held + (lock,)
+                else:
+                    self._visit(item.context_expr, held)
+            for stmt in node.body:
+                self._visit(stmt, held)
+            return
+        if isinstance(node, ast.Call):
+            self._classify_call(node, held)
+        elif isinstance(node, ast.Attribute):
+            self._classify_attr(node, held)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, held)
+
+    # -- attribute state sites ----------------------------------------------
+
+    def _receiver(self, node: ast.Attribute) -> Optional[str]:
+        """'self', a plain local name, or None for deeper chains."""
+        if isinstance(node.value, ast.Name):
+            name = node.value.id
+            if name in self.imported:
+                return None
+            return name
+        return None
+
+    def _classify_attr(self, node: ast.Attribute,
+                       held: Tuple[str, ...]) -> None:
+        if id(node) in self._call_funcs:
+            return
+        recv = self._receiver(node)
+        if recv is None:
+            return
+        if recv == "self" and node.attr in self.cls.lock_attrs:
+            return  # the lock object itself, not guarded state
+        is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+        owner = "self" if recv == "self" else f"@{node.attr}"
+        self.mm.sites.append(AccessSite(
+            owner=owner, attr=node.attr, method=self.mm.qualname,
+            lineno=node.lineno, is_write=is_write, locks=frozenset(held),
+        ))
+
+    # -- calls ---------------------------------------------------------------
+
+    def _classify_call(self, node: ast.Call,
+                       held: Tuple[str, ...]) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            self._call_funcs.add(id(func))
+        # thread creation
+        tgt = _thread_target(node)
+        callee = _dotted(func)
+        if callee in ("threading.Thread", "Thread"):
+            has_name = any(kw.arg == "name" for kw in node.keywords)
+            self.cls.thread_sites.append(
+                (node.lineno, has_name, tgt, self.mm.qualname)
+            )
+        if tgt is not None:
+            self.cls.thread_entries.add(tgt)
+
+        # constructor-shaped calls feed param-passthrough typing
+        tail = callee.split(".")[-1]
+        if tail and tail[0].isupper() and callee not in _LOCK_CTORS \
+                and callee not in _EVENT_CTORS \
+                and callee not in _QUEUE_CTORS:
+            self.ctor_calls.append((
+                tail,
+                [_ctor_class(a) for a in node.args],
+                {kw.arg: t for kw in node.keywords if kw.arg
+                 for t in [_ctor_class(kw.value)] if t is not None},
+            ))
+
+        if not isinstance(func, ast.Attribute):
+            # bare-name call: only module-path blocking shapes apply
+            self._classify_blocking(node, None, None, callee, held)
+            return
+
+        meth = func.attr
+        recv_node = func.value
+        if isinstance(recv_node, ast.Name) and recv_node.id == "self":
+            # self.m(...)
+            self.mm.self_calls.append((meth, held, node.lineno))
+            self._classify_blocking(node, "self", None, callee, held)
+            return
+        if isinstance(recv_node, ast.Attribute) and \
+                isinstance(recv_node.value, ast.Name):
+            base = recv_node.value.id
+            attr = recv_node.attr
+            if base == "self":
+                # self.X.m(...)
+                if attr in self.cls.lock_attrs and meth == "acquire":
+                    self.manual_acq.add(attr)
+                    self.mm.acquisitions.append(Acquisition(
+                        lock=self.cls.lock_name(attr), held=held,
+                        lineno=node.lineno, method=self.mm.qualname,
+                    ))
+                elif attr in self.cls.lock_attrs and meth == "release":
+                    self.manual_rel.add(attr)
+                if attr in self.cls.lock_attrs and meth in _MUTATOR_METHODS:
+                    pass
+                elif meth in _MUTATOR_METHODS:
+                    self.mm.sites.append(AccessSite(
+                        owner="self", attr=attr, method=self.mm.qualname,
+                        lineno=node.lineno, is_write=True,
+                        locks=frozenset(held),
+                    ))
+                self.mm.attr_calls.append(
+                    (attr, "self", meth, held, node.lineno)
+                )
+            elif base not in self.imported:
+                # local.X.m(...) — owner class resolves by unique attr
+                if meth in _MUTATOR_METHODS:
+                    self.mm.sites.append(AccessSite(
+                        owner=f"@{attr}", attr=attr, method=self.mm.qualname,
+                        lineno=node.lineno, is_write=True,
+                        locks=frozenset(held),
+                    ))
+                self.mm.attr_calls.append(
+                    (attr, f"@{attr}", meth, held, node.lineno)
+                )
+            self._classify_blocking(node, base, attr, callee, held)
+            return
+        if isinstance(recv_node, ast.Name):
+            # local.m(...): a mutator on a bound local is a write of THAT
+            # local's binding — the unique-attr pass cannot attribute it,
+            # so record the call shape (JL023's join detection) and
+            # classify blocking only
+            self.mm.local_calls.append(
+                (recv_node.id, meth, held, node.lineno)
+            )
+            self._classify_blocking(node, recv_node.id, None, callee, held)
+            return
+        self._classify_blocking(node, None, None, callee, held)
+
+    def _classify_blocking(self, node: ast.Call, base: Optional[str],
+                           attr: Optional[str], callee: str,
+                           held: Tuple[str, ...]) -> None:
+        # recorded regardless of the lexically-held set: a helper whose
+        # entry locks are inferred later may make this blocking call
+        # effectively under a lock — JL021 filters on the union
+        func = node.func
+        meth = func.attr if isinstance(func, ast.Attribute) else None
+        desc: Optional[str] = None
+        if callee.startswith(_BLOCKING_DOTTED_PREFIXES) or \
+                callee in _BLOCKING_DOTTED:
+            desc = f"{callee}()"
+        elif meth == "result":
+            desc = "future.result()"
+        elif meth in _BLOCKING_SOCKET_METHODS:
+            desc = f".{meth}() (socket send/recv)"
+        elif meth in ("wait", "wait_for") and base == "self" and attr:
+            kind = self.cls.attr_kinds.get(attr)
+            if kind == "event":
+                desc = f"self.{attr}.wait() (Event.wait)"
+            # Condition.wait on the lock being held RELEASES it while
+            # parked — the standard pattern, never a convoy: exempt
+        elif meth in ("get", "put") and base == "self" and attr:
+            kind = self.cls.attr_kinds.get(attr)
+            if kind == "queue":
+                desc = f"self.{attr}.{meth}() (queue.{meth})"
+            elif kind == "simplequeue" and meth == "get":
+                # SimpleQueue.put never blocks; .get does
+                desc = f"self.{attr}.get() (queue.get)"
+        elif meth == "compile":
+            spelled = _dotted(func.value) if isinstance(func, ast.Attribute) \
+                else ""
+            if "registr" in spelled or "lowered" in spelled.split("."):
+                desc = f"{spelled}.compile() (XLA compile)"
+        if desc is not None:
+            self.mm.blocking.append(BlockingCall(
+                desc=desc, locks=held, lineno=node.lineno,
+                method=self.mm.qualname,
+            ))
+
+
+def _scan_init(cls: ClassModel, init: ast.FunctionDef) -> None:
+    cls.init_params = [a.arg for a in init.args.args[1:]] + \
+        [a.arg for a in init.args.kwonlyargs]
+    for node in ast.walk(init):
+        targets: List[ast.expr] = []
+        value: Optional[ast.AST] = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if value is None:
+            continue
+        for t in targets:
+            if not (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                continue
+            cls.init_attrs.add(t.attr)
+            kd = _ctor_kind(value)
+            if kd is not None:
+                kind, detail = kd
+                if kind == "lock":
+                    cls.lock_attrs[t.attr] = detail
+                    cls.attr_kinds[t.attr] = detail
+                else:
+                    cls.attr_kinds[t.attr] = kind if kind != "queue" \
+                        else detail
+            typed = _ctor_class(value)
+            if typed is not None:
+                cls.attr_types.setdefault(t.attr, typed)
+            if isinstance(value, ast.Name) and \
+                    value.id in cls.init_params:
+                cls.param_attrs[value.id] = t.attr
+
+
+def _module_imported_names(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out.add((a.asname or a.name).split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                out.add(a.asname or a.name)
+    return out
+
+
+def build_module_model(path: str, tree: ast.Module) -> ModuleConcurrency:
+    model = ModuleConcurrency(path=path)
+    imported = _module_imported_names(tree)
+
+    classdefs: List[ast.ClassDef] = [
+        n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)
+    ]
+    for cd in classdefs:
+        cls = ClassModel(name=cd.name, lineno=cd.lineno)
+        model.classes[cd.name] = cls
+        methods = [
+            n for n in cd.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        init = next((m for m in methods if m.name == "__init__"), None)
+        if init is not None:
+            _scan_init(cls, init)
+        for m in methods:
+            mm = MethodModel(
+                name=m.name, qualname=f"{cd.name}.{m.name}",
+                lineno=m.lineno,
+            )
+            cls.methods[m.name] = mm
+            sc = _MethodScanner(cls, mm, imported, model.ctor_calls)
+            sc.scan(m.body)
+            mm.manual_locks = frozenset(
+                cls.lock_name(a) for a in (sc.manual_acq & sc.manual_rel)
+            )
+
+    # module-level thread creation (functions outside classes)
+    class_nodes: Set[int] = set()
+    for cd in classdefs:
+        for n in ast.walk(cd):
+            class_nodes.add(id(n))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_thread_ctor(node) \
+                and id(node) not in class_nodes:
+            has_name = any(kw.arg == "name" for kw in node.keywords)
+            model.module_thread_sites.append(
+                (node.lineno, has_name, _thread_target(node), "<module>")
+            )
+
+    _finalize(model)
+    return model
+
+
+def _finalize(model: ModuleConcurrency) -> None:
+    """Resolve unique-attr owners, entry locks, param-passthrough
+    types, and the thread-reachability closure."""
+    # attr name -> declaring class, when unambiguous across the module
+    declared: Dict[str, List[str]] = {}
+    for cls in model.classes.values():
+        for attr in cls.init_attrs:
+            declared.setdefault(attr, []).append(cls.name)
+    model.unique_attr_owner = {
+        attr: owners[0] for attr, owners in declared.items()
+        if len(owners) == 1
+    }
+
+    # constructor-argument passthrough: Owner(..., ClassName(...)) types
+    # Owner's param-assigned attribute as ClassName
+    _apply_param_passthrough(model.ctor_calls, model.classes)
+
+    for cls in model.classes.values():
+        # helper call-through: a private helper whose every intra-class
+        # call site holds L is analyzed with L held at entry
+        call_sites: Dict[str, List[FrozenSet[str]]] = {}
+        for mm in cls.methods.values():
+            for callee, held, _ in mm.self_calls:
+                call_sites.setdefault(callee, []).append(frozenset(held))
+        for name, mm in cls.methods.items():
+            if not name.startswith("_") or name.startswith("__") \
+                    or name in cls.thread_entries:
+                continue
+            sites = call_sites.get(name)
+            if not sites:
+                continue
+            common = frozenset.intersection(*sites)
+            if common:
+                mm.entry_locks = common
+        for mm in cls.methods.values():
+            if mm.manual_locks:
+                mm.entry_locks = mm.entry_locks | mm.manual_locks
+
+        # thread-reachability: entries, closed over self-calls
+        reachable = set(cls.thread_entries) & set(cls.methods)
+        frontier = list(reachable)
+        while frontier:
+            m = frontier.pop()
+            for callee, _, _ in cls.methods[m].self_calls:
+                if callee in cls.methods and callee not in reachable:
+                    reachable.add(callee)
+                    frontier.append(callee)
+        for name in reachable:
+            cls.methods[name].thread_reachable = True
+
+
+def _apply_param_passthrough(
+    ctor_calls: List[Tuple[str, List[Optional[str]], Dict[str, str]]],
+    registry: Dict[str, ClassModel],
+) -> None:
+    """``self.x = param`` + a call site ``Owner(..., ClassName(...))``
+    types ``Owner.x`` as ``ClassName`` — the ``Replica(...,
+    CircuitBreaker(...))`` shape, where the breaker's class is only
+    visible at the router's construction site."""
+    for tail, pos_tails, kw_tails in ctor_calls:
+        cls = registry.get(tail)
+        if cls is None or not cls.param_attrs:
+            continue
+        for i, arg_tail in enumerate(pos_tails):
+            if arg_tail is None or i >= len(cls.init_params):
+                continue
+            attr = cls.param_attrs.get(cls.init_params[i])
+            if attr is not None:
+                cls.attr_types.setdefault(attr, arg_tail)
+        for kw, arg_tail in kw_tails.items():
+            attr = cls.param_attrs.get(kw)
+            if attr is not None:
+                cls.attr_types.setdefault(attr, arg_tail)
+
+
+def module_model(mod) -> ModuleConcurrency:
+    """The memoized per-ModuleInfo concurrency model (rules share it)."""
+    cached = getattr(mod, "_concurrency_model", None)
+    if cached is None:
+        cached = build_module_model(mod.path, mod.tree)
+        mod._concurrency_model = cached
+    return cached
+
+
+# ---------------------------------------------------------------------------
+# cross-module merge + lock-order graph
+# ---------------------------------------------------------------------------
+
+
+def merge_models(models: List[ModuleConcurrency]) -> Dict[str, ClassModel]:
+    """One registry of class models across every analyzed module.  A
+    class name defined in two modules is dropped from cross-class
+    resolution (ambiguous) but keeps its per-module rules."""
+    seen: Dict[str, ClassModel] = {}
+    dupes: Set[str] = set()
+    for m in models:
+        for name, cls in m.classes.items():
+            if name in seen:
+                dupes.add(name)
+            else:
+                seen[name] = cls
+    for name in dupes:
+        seen.pop(name, None)
+    return seen
+
+
+def _acquired_locks(cls: ClassModel, method: str,
+                    depth: int = 1,
+                    registry: Optional[Dict[str, ClassModel]] = None,
+                    unique_owner: Optional[Dict[str, str]] = None
+                    ) -> Set[str]:
+    """Locks ``cls.method`` acquires — direct acquisitions plus one
+    level of self-call-through, plus (when a class registry is given)
+    one level of cross-class call-through on typed attributes: the
+    ``run() -> self._compile() -> self.program_registry.compile()``
+    shape, where the inner lock belongs to another class."""
+    mm = cls.methods.get(method)
+    if mm is None:
+        return set()
+    out = {a.lock for a in mm.acquisitions}
+    if depth > 0:
+        for callee, _, _ in mm.self_calls:
+            out |= _acquired_locks(cls, callee, depth=depth - 1,
+                                   registry=registry,
+                                   unique_owner=unique_owner)
+    if registry is not None and unique_owner is not None:
+        for attr, owner_tag, callee, _, _ in mm.attr_calls:
+            target = _attr_owner_class(
+                cls, owner_tag, attr, registry, unique_owner
+            )
+            if target is not None:
+                target_mm = target.methods.get(callee)
+                if target_mm is not None:
+                    out |= {a.lock for a in target_mm.acquisitions}
+    return out
+
+
+# method names too generic to identify a receiver's class (dict/list/
+# primitive protocol + lifecycle verbs every class spells)
+_COMMON_METHODS = {
+    "get", "put", "pop", "append", "add", "remove", "clear", "update",
+    "items", "keys", "values", "join", "start", "set", "is_set",
+    "acquire", "release", "wait", "wait_for", "notify", "notify_all",
+    "close", "stop", "run", "submit", "result", "emit", "observe",
+    "inc", "read", "write", "send", "recv", "copy", "extend", "index",
+}
+
+
+def _unique_method_owner(registry: Dict[str, ClassModel], meth: str
+                         ) -> Optional[ClassModel]:
+    """The one class defining ``meth``, when the name is distinctive
+    enough to identify a local receiver (``router.wait_state(...)`` →
+    FleetRouter).  Generic protocol names never resolve."""
+    if meth in _COMMON_METHODS or meth.startswith("__"):
+        return None
+    owners = [
+        cls for cls in registry.values() if meth in cls.methods
+    ]
+    if len(owners) == 1:
+        return owners[0]
+    return None
+
+
+def _attr_owner_class(cls: ClassModel, owner_tag: str, attr: str,
+                      registry: Dict[str, ClassModel],
+                      unique_attr_owner: Dict[str, str]
+                      ) -> Optional[ClassModel]:
+    """The ClassModel behind an attr_call receiver."""
+    if owner_tag == "self":
+        typed = cls.attr_types.get(attr)
+        if typed is not None:
+            return registry.get(typed)
+        return None
+    # '@attr' — a local receiver; the unique declaring class's typed
+    # attribute resolves it (rep.breaker -> Replica.breaker -> its type)
+    decl = unique_attr_owner.get(attr)
+    if decl is None:
+        return None
+    decl_cls = registry.get(decl)
+    if decl_cls is None:
+        return None
+    typed = decl_cls.attr_types.get(attr)
+    if typed is not None:
+        return registry.get(typed)
+    return None
+
+
+def lock_edges(models: List[ModuleConcurrency]
+               ) -> Dict[Tuple[str, str], List[str]]:
+    """Directed edges A -> B ("A is acquired before/around B") with the
+    evidence sites that produced them."""
+    registry = merge_models(models)
+    # merge unique-attr owners across modules (drop ambiguous)
+    decl: Dict[str, List[str]] = {}
+    for m in models:
+        for cls in m.classes.values():
+            for attr in cls.init_attrs:
+                decl.setdefault(attr, []).append(cls.name)
+    unique_owner = {a: o[0] for a, o in decl.items() if len(o) == 1}
+    # cross-module param passthrough: a ctor call in one module may type
+    # an attribute of a class defined in another
+    for m in models:
+        _apply_param_passthrough(m.ctor_calls, registry)
+
+    edges: Dict[Tuple[str, str], List[str]] = {}
+
+    def add(a: str, b: str, why: str) -> None:
+        if a == b:
+            return
+        edges.setdefault((a, b), []).append(why)
+
+    for m in models:
+        for cls in m.classes.values():
+            for mm in cls.methods.values():
+                entry = tuple(sorted(mm.entry_locks))
+                for acq in mm.acquisitions:
+                    for h in set(acq.held) | set(entry):
+                        add(h, acq.lock,
+                            f"{m.path}:{acq.lineno} {mm.qualname}")
+                for callee, held, lineno in mm.self_calls:
+                    outer = set(held) | set(entry)
+                    if not outer:
+                        continue
+                    for inner in _acquired_locks(
+                        cls, callee, registry=registry,
+                        unique_owner=unique_owner,
+                    ):
+                        for h in outer:
+                            add(h, inner,
+                                f"{m.path}:{lineno} {mm.qualname} -> "
+                                f"self.{callee}()")
+                for attr, owner_tag, callee, held, lineno in mm.attr_calls:
+                    outer = set(held) | set(entry)
+                    if not outer:
+                        continue
+                    target = _attr_owner_class(
+                        cls, owner_tag, attr, registry, unique_owner
+                    )
+                    if target is None:
+                        continue
+                    for inner in _acquired_locks(target, callee):
+                        for h in outer:
+                            add(h, inner,
+                                f"{m.path}:{lineno} {mm.qualname} -> "
+                                f".{attr}.{callee}()")
+                for recv, callee, held, lineno in mm.local_calls:
+                    outer = set(held) | set(entry)
+                    if not outer:
+                        continue
+                    target = _unique_method_owner(registry, callee)
+                    if target is None:
+                        continue
+                    for inner in _acquired_locks(target, callee):
+                        for h in outer:
+                            add(h, inner,
+                                f"{m.path}:{lineno} {mm.qualname} -> "
+                                f"{recv}.{callee}()")
+    return edges
+
+
+def find_cycle(edges: Dict[Tuple[str, str], List[str]]
+               ) -> Optional[List[str]]:
+    """A lock-order cycle as [a, b, ..., a], or None."""
+    adj: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set())
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in adj}
+    stack: List[str] = []
+
+    def dfs(n: str) -> Optional[List[str]]:
+        color[n] = GREY
+        stack.append(n)
+        for nxt in sorted(adj[n]):
+            if color[nxt] == GREY:
+                i = stack.index(nxt)
+                return stack[i:] + [nxt]
+            if color[nxt] == WHITE:
+                found = dfs(nxt)
+                if found:
+                    return found
+        stack.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(adj):
+        if color[n] == WHITE:
+            found = dfs(n)
+            if found:
+                return found
+    return None
+
+
+def topological_order(edges: Dict[Tuple[str, str], List[str]],
+                      all_locks: Set[str]) -> List[str]:
+    """Kahn's algorithm with an alphabetical tiebreak: a deterministic
+    total order over every known lock, consistent with the edges.
+    Raises ValueError on a cycle."""
+    nodes = set(all_locks)
+    for a, b in edges:
+        nodes.add(a)
+        nodes.add(b)
+    indeg: Dict[str, int] = {n: 0 for n in nodes}
+    adj: Dict[str, Set[str]] = {n: set() for n in nodes}
+    for a, b in edges:
+        if b not in adj[a]:
+            adj[a].add(b)
+            indeg[b] += 1
+    ready = sorted(n for n in nodes if indeg[n] == 0)
+    order: List[str] = []
+    while ready:
+        n = ready.pop(0)
+        order.append(n)
+        changed = False
+        for nxt in adj[n]:
+            indeg[nxt] -= 1
+            if indeg[nxt] == 0:
+                ready.append(nxt)
+                changed = True
+        if changed:
+            ready.sort()
+    if len(order) != len(nodes):
+        raise ValueError("lock-order graph has a cycle")
+    return order
+
+
+def all_lock_names(models: List[ModuleConcurrency]) -> Set[str]:
+    out: Set[str] = set()
+    for m in models:
+        for cls in m.classes.values():
+            for attr in cls.lock_attrs:
+                out.add(cls.lock_name(attr))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# program-wide artifact (analysis/lockorder.json)
+# ---------------------------------------------------------------------------
+
+
+def tree_models(paths: Optional[List[str]] = None
+                ) -> List[ModuleConcurrency]:
+    """Concurrency models for every in-scope package module under
+    ``paths`` (default: the standard lint paths, restricted to
+    ``speakingstyle_tpu/`` sources the concurrency rules cover)."""
+    from speakingstyle_tpu.analysis import linter
+
+    root = linter.repo_root()
+    models: List[ModuleConcurrency] = []
+    for fp in linter.iter_py_files(paths or linter.default_lint_paths()):
+        rel = os.path.relpath(os.path.abspath(fp), root).replace(
+            os.sep, "/"
+        )
+        if "speakingstyle_tpu/" not in rel or "tests/" in rel:
+            continue
+        if rel.endswith("obs/locks.py"):
+            # the witness itself: TrackedLock._inner wraps the real
+            # primitives and must not appear as an app lock in the order
+            continue
+        try:
+            with open(fp, "r", encoding="utf-8") as fh:
+                src = fh.read()
+        except (OSError, UnicodeDecodeError):
+            continue
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        models.append(build_module_model(rel, tree))
+    return models
+
+
+def _evidence_key(why: str) -> str:
+    """Evidence strings minus line numbers, so unrelated edits in a
+    file don't churn the committed artifact (same policy as the lint
+    baseline's line-free fingerprints)."""
+    head, _, rest = why.partition(" ")
+    return head.rsplit(":", 1)[0] + " " + rest
+
+
+def lockorder_artifact(models: List[ModuleConcurrency]) -> dict:
+    """The checked-in ``lockorder.json`` payload: the edge list with
+    line-free evidence plus the total acquisition order the runtime
+    witness (``obs.locks.TrackedLock``) enforces.
+
+    Raises ``ValueError`` naming the cycle if the graph is cyclic.
+    """
+    edges = lock_edges(models)
+    cycle = find_cycle(edges)
+    if cycle is not None:
+        raise ValueError("lock-order cycle: " + " -> ".join(cycle))
+    order = topological_order(edges, all_lock_names(models))
+    return {
+        "comment": (
+            "Static lock-acquisition order (jaxlint JL022). 'order' is "
+            "the total order TrackedLock enforces at runtime under "
+            "SPEAKINGSTYLE_CHECKS=1: a thread may only acquire locks in "
+            "increasing order position. Regenerate with `python -m "
+            "speakingstyle_tpu.analysis.cli lockorder --write`; "
+            "`--check` fails if this file is stale."
+        ),
+        "version": 1,
+        "edges": [
+            {
+                "before": a,
+                "after": b,
+                "evidence": sorted({_evidence_key(w) for w in whys}),
+            }
+            for (a, b), whys in sorted(edges.items())
+        ],
+        "order": order,
+    }

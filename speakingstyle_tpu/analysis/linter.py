@@ -16,6 +16,7 @@ import collections
 import io
 import json
 import os
+import time
 import tokenize
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -43,6 +44,12 @@ def default_baseline_path() -> str:
     )
 
 
+def default_lockorder_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "lockorder.json"
+    )
+
+
 def default_lint_paths() -> List[str]:
     root = repo_root()
     out = []
@@ -64,6 +71,10 @@ def _directives(source: str) -> Tuple[bool, Dict[int, Optional[set]]]:
     ``None`` as the rule set means "disable everything on this line".
     Uses the tokenizer so string literals containing 'jaxlint:' are not
     misread as directives.
+
+    A directive that is the only thing on its line applies to the NEXT
+    line instead — so long ``reason=`` clauses don't force overlong
+    code lines.
     """
     skip_file = False
     per_line: Dict[int, Optional[set]] = {}
@@ -76,18 +87,29 @@ def _directives(source: str) -> Tuple[bool, Dict[int, Optional[set]]]:
             if not text.startswith("jaxlint:"):
                 continue
             body = text[len("jaxlint:"):].strip()
+            target = tok.start[0]
+            if tok.line.lstrip().startswith("#"):
+                target += 1   # standalone comment: guards the next line
             if body == "skip-file":
                 skip_file = True
             elif body == "disable":
-                per_line[tok.start[0]] = None
+                per_line[target] = None
             elif body.startswith("disable="):
+                spec = body[len("disable="):]
+                # an optional trailing reason clause documents WHY a
+                # deliberate pattern is suppressed:
+                #   # jaxlint: disable=JL020 reason=single-reader stamp
+                # (the concurrency rules require one; the reason text is
+                # free-form and ends at end-of-comment)
+                if " reason=" in spec:
+                    spec = spec.split(" reason=", 1)[0]
                 rules = {
                     r.strip().upper()
-                    for r in body[len("disable="):].split(",")
+                    for r in spec.split(",")
                     if r.strip()
                 }
-                existing = per_line.get(tok.start[0], set())
-                per_line[tok.start[0]] = (
+                existing = per_line.get(target, set())
+                per_line[target] = (
                     None if existing is None else existing | rules
                 )
     except tokenize.TokenError:
@@ -109,9 +131,14 @@ def lint_source(
     source: str,
     path: str = "<string>",
     select: Optional[Iterable[str]] = None,
+    profile: Optional[Dict[str, float]] = None,
 ) -> List[Finding]:
     """Lint one source string; ``path`` is used for reporting/fingerprints
-    and for path-scoped rules (JL004 looks for ``training/``)."""
+    and for path-scoped rules (JL004 looks for ``training/``).
+
+    ``profile``, if given, accumulates per-rule wall seconds
+    (``--profile`` in the CLI).
+    """
     skip_file, per_line = _directives(source)
     if skip_file:
         return []
@@ -134,9 +161,14 @@ def lint_source(
     for code, rule in sorted(RULES.items()):
         if code not in wanted:
             continue
+        t0 = time.perf_counter() if profile is not None else 0.0
         for f in rule(mod):
             if not _suppressed(f, per_line):
                 findings.append(f)
+        if profile is not None:
+            profile[code] = (
+                profile.get(code, 0.0) + time.perf_counter() - t0
+            )
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings
 
@@ -161,6 +193,7 @@ def lint_paths(
     paths: Optional[Sequence[str]] = None,
     select: Optional[Iterable[str]] = None,
     root: Optional[str] = None,
+    profile: Optional[Dict[str, float]] = None,
 ) -> List[Finding]:
     """Lint files/trees; paths in findings are repo-root-relative."""
     root = root or repo_root()
@@ -175,7 +208,9 @@ def lint_paths(
                 source = fh.read()
         except (OSError, UnicodeDecodeError):
             continue
-        findings.extend(lint_source(source, rel, select=select))
+        findings.extend(
+            lint_source(source, rel, select=select, profile=profile)
+        )
     return findings
 
 

@@ -89,6 +89,33 @@ JL019  full-utterance accumulation in serving code: a list that is
        rebuilt whole. Complements JL015 (which flags the concatenate
        CALL in a loop/handler; JL019 catches the concat-after-loop
        spelling JL015's loop test misses). Tree baseline: zero.
+JL020  torn-state race: a class attribute accessed under a lock in one
+       method and read/written lock-free in another, in a class whose
+       methods run on more than one thread (analysis/concurrency.py
+       guarded-by inference: ``with self._lock:`` scope tracking plus
+       one level of helper call-through, with replica-style local
+       receivers bound to the declaring class). Exempt: Events, queue
+       objects, obs.registry metrics, the lock objects themselves, and
+       ``# jaxlint: disable=JL020 reason=...``. Tree baseline: zero.
+JL021  blocking call under a lock (lock convoy / deadlock feeder):
+       future.result, Event.wait, queue get/put (SimpleQueue.put is
+       non-blocking and exempt), socket send/recv, subprocess, HTTP,
+       time.sleep, or a registry/XLA compile while holding any
+       recognized lock. Condition.wait on the lock being held is the
+       sanctioned wait idiom and exempt. Tree baseline: zero.
+JL022  lock-order cycle: nested ``with self._lock`` acquisitions (plus
+       self-method and cross-class call-through) form the static
+       lock-order graph; a cycle within one module is an error here,
+       and the program-wide acyclic order is the checked-in
+       analysis/lockorder.json (``cli lockorder --write``), which the
+       runtime TrackedLock witness (obs/locks.py) enforces under
+       SPEAKINGSTYLE_CHECKS=1. Tree baseline: zero.
+JL023  unsupervised thread: ``threading.Thread(...)`` without a
+       ``name=`` (invisible to the watchdog/supervision machinery), or
+       a thread-creating class with no close()/stop() path that joins
+       the thread or sets a stop Event. Scoped to speakingstyle_tpu/
+       (bench/test harness threads are deliberately ad hoc).
+       Tree baseline: zero.
 """
 
 import ast
@@ -167,18 +194,34 @@ class ModuleInfo:
         self.path = path
         self.source = source
         self.tree = tree
+        # memoized ast.walk: every rule that used to run its own full
+        # traversal shares one cached node list per subtree, so linting a
+        # file costs one AST pass (plus one per distinct function subtree
+        # a rule inspects) instead of one pass per rule
+        self._walk_cache: Dict[int, List[ast.AST]] = {}
         self.parents: Dict[ast.AST, ast.AST] = {}
-        for parent in ast.walk(tree):
+        for parent in self.walk():
             for child in ast.iter_child_nodes(parent):
                 self.parents[child] = parent
 
         self.functions: List[ast.FunctionDef] = [
-            n for n in ast.walk(tree)
+            n for n in self.walk()
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
         ]
         self._jitted_names = self._collect_jitted_names()
         self._partial_static_params = self._collect_partial_bindings()
         self._traced = {f for f in self.functions if self._is_traced(f)}
+
+    def walk(self, node: Optional[ast.AST] = None) -> List[ast.AST]:
+        """``list(ast.walk(node or tree))``, memoized per subtree. The
+        cached list preserves ast.walk's exact BFS order, so findings are
+        byte-identical to the per-rule-walk implementation."""
+        key = -1 if node is None or node is self.tree else id(node)
+        cached = self._walk_cache.get(key)
+        if cached is None:
+            cached = list(ast.walk(self.tree if key == -1 else node))
+            self._walk_cache[key] = cached
+        return cached
 
     # -- context helpers ----------------------------------------------------
 
@@ -217,7 +260,7 @@ class ModuleInfo:
         """Function names that appear as the traced argument of a jax
         transform call anywhere in the file: ``jax.jit(step_fn, ...)``."""
         names: Set[str] = set()
-        for node in ast.walk(self.tree):
+        for node in self.walk():
             if not isinstance(node, ast.Call):
                 continue
             callee = _dotted(node.func)
@@ -236,7 +279,7 @@ class ModuleInfo:
         statically — they are Python values at trace time, not tracers."""
         out: Dict[str, Set[str]] = {}
         defs = {f.name: f for f in self.functions}
-        for node in ast.walk(self.tree):
+        for node in self.walk():
             if not isinstance(node, ast.Call):
                 continue
             if _dotted(node.func) not in ("functools.partial", "partial"):
@@ -301,14 +344,14 @@ class ModuleInfo:
         producers: Set[str] = set()
         jitted_locals = set(self._jitted_names)
         # names bound directly to a jit wrapper: g = jax.jit(...)
-        for node in ast.walk(fn):
+        for node in self.walk(fn):
             if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
                 if _dotted(node.value.func) in _TRACING_TRANSFORMS:
                     for t in node.targets:
                         if isinstance(t, ast.Name):
                             jitted_locals.add(t.id)
         # locally @jax.jit-decorated defs
-        for sub in ast.walk(fn):
+        for sub in self.walk(fn):
             if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
                     sub in self._traced:
                 jitted_locals.add(sub.name)
@@ -323,7 +366,7 @@ class ModuleInfo:
                 return True
             return callee in jitted_locals
 
-        for node in ast.walk(fn):
+        for node in self.walk(fn):
             if isinstance(node, ast.Assign) and produces_array(node.value):
                 for t in node.targets:
                     for n in ast.walk(t):
@@ -427,7 +470,7 @@ def rule_jl001(mod: ModuleInfo) -> Iterator[Finding]:
         arrays = mod.array_locals(fn)
         suspects = params | arrays
         qual = mod.qualname(fn)
-        for node in ast.walk(fn):
+        for node in mod.walk(fn):
             if isinstance(node, (ast.If, ast.While)):
                 test = node.test
                 kind = "if" if isinstance(node, ast.If) else "while"
@@ -482,7 +525,7 @@ def rule_jl002(mod: ModuleInfo) -> Iterator[Finding]:
             continue
         qual = mod.qualname(fn)
         traced = mod.is_in_traced_context(fn.body[0]) if fn.body else False
-        for node in ast.walk(fn):
+        for node in mod.walk(fn):
             if not isinstance(node, ast.Call):
                 continue
             callee = _dotted(node.func)
@@ -528,7 +571,7 @@ def _jit_callsites(mod: ModuleInfo):
     ``@functools.partial(jax.jit, **kw)`` decorations.
     """
     defs = {f.name: f for f in mod.functions}
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.Call) and \
                 _dotted(node.func) in _JIT_CONSTRUCTORS:
             target = None
@@ -660,7 +703,7 @@ def rule_jl003(mod: ModuleInfo) -> Iterator[Finding]:
                                 name = t.id
                 if name and idxs:
                     static_of[name] = idxs
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Name):
             continue
         idxs = static_of.get(node.func.id)
@@ -705,7 +748,7 @@ def rule_jl004(mod: ModuleInfo) -> Iterator[Finding]:
     """
     if "training/" not in mod.path.replace("\\", "/"):
         return
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call):
             continue
         if not mod.enclosing_loops(node):
@@ -847,7 +890,7 @@ def rule_jl005(mod: ModuleInfo) -> Iterator[Finding]:
                     ),
                 )
 
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call):
             continue
         callee = _dotted(node.func)
@@ -897,7 +940,7 @@ def rule_jl006(mod: ModuleInfo) -> Iterator[Finding]:
     degraded training.
     """
     # (c) constant PRNGKey in traced context
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.Call) and _dotted(node.func) in (
             "jax.random.PRNGKey", "jax.random.key"
         ):
@@ -926,7 +969,7 @@ def rule_jl006(mod: ModuleInfo) -> Iterator[Finding]:
             if n in ("rng", "key", "prng", "prng_key") or \
                     n.endswith(("_rng", "_key")):
                 keys.add(n)
-        for node in ast.walk(fn):
+        for node in mod.walk(fn):
             if isinstance(node, ast.Assign) and _is_key_producer(node.value):
                 for t in node.targets:
                     for nm in ast.walk(t):
@@ -936,7 +979,7 @@ def rule_jl006(mod: ModuleInfo) -> Iterator[Finding]:
             continue
 
         events: List[Tuple[int, str, str, ast.AST]] = []  # (line, kind, key, node)
-        for node in ast.walk(fn):
+        for node in mod.walk(fn):
             if isinstance(node, ast.Assign):
                 for t in node.targets:
                     for nm in ast.walk(t):
@@ -1002,7 +1045,7 @@ def rule_jl006(mod: ModuleInfo) -> Iterator[Finding]:
                             )
                             for t in n.targets
                         )
-                        for n in ast.walk(loop)
+                        for n in mod.walk(loop)
                     )
                     defined_outside = not (
                         loop.lineno <= _first_def_line(fn, k, events)
@@ -1113,7 +1156,7 @@ def rule_jl007(mod: ModuleInfo) -> Iterator[Finding]:
     p = mod.path.replace("\\", "/")
     if "speakingstyle_tpu/" not in p:
         return
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.ExceptHandler):
             continue
         broad = _handler_is_broad(node)
@@ -1180,7 +1223,7 @@ def rule_jl008(mod: ModuleInfo) -> Iterator[Finding]:
     inside functions named ``precompile``/``warmup`` are exempt — that IS
     the sanctioned startup pattern.
     """
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call):
             continue
         is_jit = _dotted(node.func) in _JIT_CALL_NAMES
@@ -1231,7 +1274,7 @@ def rule_jl009(mod: ModuleInfo) -> Iterator[Finding]:
     fields), which are never subtracted.
     """
     wall = {"time.time"}
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.ImportFrom) and node.module == "time":
             for alias in node.names:
                 if alias.name == "time":
@@ -1241,14 +1284,14 @@ def rule_jl009(mod: ModuleInfo) -> Iterator[Finding]:
         return isinstance(n, ast.Call) and _dotted(n.func) in wall
 
     stamps: Set[str] = set()
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.Assign) and is_wall_call(node.value):
             for t in node.targets:
                 for nm in ast.walk(t):
                     if isinstance(nm, ast.Name):
                         stamps.add(nm.id)
 
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.BinOp) or not isinstance(node.op, ast.Sub):
             continue
         hits = []
@@ -1296,14 +1339,14 @@ def _jl010_jitted_names(mod: ModuleInfo, fn: ast.FunctionDef) -> Set[str]:
     assigned from an AOT ``.lower(...).compile()`` chain, or locally
     ``@jax.jit``-decorated."""
     jitted = set(mod._jitted_names)
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
             if _dotted(node.value.func) in _TRACING_TRANSFORMS or \
                     _is_aot_compile_chain(node.value):
                 for t in node.targets:
                     if isinstance(t, ast.Name):
                         jitted.add(t.id)
-    for sub in ast.walk(fn):
+    for sub in mod.walk(fn):
         if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) and \
                 sub in mod._traced:
             jitted.add(sub.name)
@@ -1346,7 +1389,7 @@ def rule_jl010(mod: ModuleInfo) -> Iterator[Finding]:
         jit_lines: List[int] = []
         sync_lines: List[int] = []
         subs: List[Tuple[int, str]] = []         # (line, stamp name)
-        for node in ast.walk(fn):
+        for node in mod.walk(fn):
             if isinstance(node, ast.Assign) and isinstance(
                 node.value, ast.Call
             ) and _dotted(node.value.func) in _MONO_CLOCK_CALLS:
@@ -1423,7 +1466,7 @@ def rule_jl011(mod: ModuleInfo) -> Iterator[Finding]:
     p = mod.path.replace("\\", "/")
     if "speakingstyle_tpu/serving/" not in p:
         return
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call):
             continue
         callee = _dotted(node.func)
@@ -1530,7 +1573,7 @@ def rule_jl012(mod: ModuleInfo) -> Iterator[Finding]:
                         "(serving/style.py)."
                     ),
                 )
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.Call):
             callee = _dotted(node.func)
             detail = None
@@ -1615,7 +1658,7 @@ def rule_jl013(mod: ModuleInfo) -> Iterator[Finding]:
     p = mod.path.replace("\\", "/")
     if "speakingstyle_tpu/serving/" not in p:
         return
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call):
             continue
         func = node.func
@@ -1693,7 +1736,7 @@ def rule_jl014(mod: ModuleInfo) -> Iterator[Finding]:
     # names assigned (lexically, anywhere in the file) from a
     # jax.devices()/jax.local_devices() subscript
     pinned: Set[str] = set()
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.Assign) and isinstance(
             node.value, ast.Subscript
         ):
@@ -1701,7 +1744,7 @@ def rule_jl014(mod: ModuleInfo) -> Iterator[Finding]:
                 pinned |= {
                     t.id for t in node.targets if isinstance(t, ast.Name)
                 }
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call):
             continue
         callee = _dotted(node.func)
@@ -1772,7 +1815,7 @@ def rule_jl015(mod: ModuleInfo) -> Iterator[Finding]:
     p = mod.path.replace("\\", "/")
     if "speakingstyle_tpu/serving/" not in p:
         return
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call):
             continue
         callee = _dotted(node.func)
@@ -1826,7 +1869,7 @@ def rule_jl016(mod: ModuleInfo) -> Iterator[Finding]:
     p = mod.path.replace("\\", "/")
     if "speakingstyle_tpu/serving/" not in p:
         return
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call):
             continue
         if _dotted(node.func) not in _SLEEP_CALLS:
@@ -1889,7 +1932,7 @@ def _scope_has_atomic_rename(mod: "ModuleInfo", node: ast.AST) -> bool:
     scope = mod.enclosing_function(node) or mod.tree
     return any(
         isinstance(n, ast.Call) and _dotted(n.func) in _ATOMIC_RENAME_CALLS
-        for n in ast.walk(scope)
+        for n in mod.walk(scope)
     )
 
 
@@ -1915,7 +1958,7 @@ def rule_jl017(mod: ModuleInfo) -> Iterator[Finding]:
     if ("speakingstyle_tpu/training/" not in p
             and "speakingstyle_tpu/serving/" not in p):
         return
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call):
             continue
         callee = _dotted(node.func)
@@ -2024,7 +2067,7 @@ def rule_jl018(mod: ModuleInfo) -> Iterator[Finding]:
             ),
         )
 
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if isinstance(node, ast.ImportFrom):
             if node.module and node.module.split(".")[0] == "jax":
                 for alias in node.names:
@@ -2077,7 +2120,7 @@ def rule_jl019(mod: ModuleInfo) -> Iterator[Finding]:
         return
     # scope id -> names of lists grown inside a loop in that scope
     grown: Dict[int, Set[str]] = {}
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call):
             continue
         f = node.func
@@ -2090,7 +2133,7 @@ def rule_jl019(mod: ModuleInfo) -> Iterator[Finding]:
         grown.setdefault(id(scope), set()).add(f.value.id)
     if not grown:
         return
-    for node in ast.walk(mod.tree):
+    for node in mod.walk():
         if not isinstance(node, ast.Call):
             continue
         callee = _dotted(node.func)
@@ -2122,6 +2165,257 @@ def rule_jl019(mod: ModuleInfo) -> Iterator[Finding]:
             )
 
 
+# ---------------------------------------------------------------------------
+# JL020–JL023 — lock-discipline rules over the class-concurrency model
+# ---------------------------------------------------------------------------
+
+
+def _concurrency_in_scope(mod: ModuleInfo) -> bool:
+    """Package code only: bench.py and tests/ create deliberately ad-hoc
+    threads and toy locks that would drown the signal."""
+    p = mod.path.replace("\\", "/")
+    return "speakingstyle_tpu/" in p and "tests/" not in p
+
+
+def _conc_model(mod: ModuleInfo):
+    from speakingstyle_tpu.analysis import concurrency
+
+    return concurrency.module_model(mod)
+
+
+def rule_jl020(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL020: torn-state race — an attribute accessed under a lock in
+    one method and read/written lock-free in another, where the class's
+    methods run on more than one thread.
+
+    The guarded-by model (analysis/concurrency.py) classifies every
+    attribute site by the ``with self._lock:`` scopes around it, widened
+    by helper call-through (a private helper whose every caller holds L
+    is analyzed with L at entry), and binds ``rep.state``-style local
+    receivers to the class that declares the attribute. A finding needs
+    all of: a guarded site, a lock-free site in a *different* method
+    (``__init__`` excluded — construction happens-before), a write
+    somewhere, and a thread-reachable method among the sites. Events,
+    queues, obs.registry metrics, and the lock objects themselves are
+    exempt (their thread-safety is internal); deliberate single-reader
+    patterns get ``# jaxlint: disable=JL020 reason=...``.
+    """
+    if not _concurrency_in_scope(mod):
+        return
+    model = _conc_model(mod)
+    # (owner class, attr) -> [(site, MethodModel, effective locks)]
+    groups: Dict[Tuple[str, str], List] = {}
+    for cls in model.classes.values():
+        for mm in cls.methods.values():
+            for s in mm.sites:
+                if s.owner == "self":
+                    owner = cls.name
+                else:
+                    owner = model.unique_attr_owner.get(s.attr)
+                    if owner is None:
+                        continue
+                owner_cls = model.classes.get(owner)
+                if owner_cls is None or s.attr not in owner_cls.init_attrs:
+                    continue
+                kind = owner_cls.attr_kinds.get(s.attr)
+                if kind is not None:
+                    continue  # lock/event/queue/metric: exempt kinds
+                eff = s.locks | mm.entry_locks
+                groups.setdefault((owner, s.attr), []).append((s, mm, eff))
+    for (owner, attr), entries in sorted(groups.items()):
+        guarded_methods = {mm.qualname for s, mm, eff in entries if eff}
+        if not guarded_methods:
+            continue
+        # the write that makes a race possible must happen outside
+        # __init__ — construction happens-before every thread start, so
+        # an attribute assigned once and then only read is immutable
+        # shared state, not a race
+        if not any(s.is_write for s, mm, _ in entries
+                   if mm.name != "__init__"):
+            continue
+        if not any(mm.thread_reachable for _, mm, _ in entries):
+            continue
+        locks = sorted(set().union(
+            *[eff for _, _, eff in entries if eff]
+        ))
+        reported: Set[str] = set()
+        for s, mm, eff in entries:
+            if eff or mm.name == "__init__":
+                continue
+            other_guarded = guarded_methods - {mm.qualname}
+            if not other_guarded:
+                continue
+            if mm.qualname in reported:
+                continue
+            reported.add(mm.qualname)
+            kind = "write" if s.is_write else "read"
+            yield Finding(
+                rule="JL020",
+                path=mod.path,
+                line=s.lineno,
+                context=mm.qualname,
+                detail=f"{owner}.{attr} lock-free in {mm.qualname}",
+                message=(
+                    f"`{owner}.{attr}` is guarded by "
+                    f"{'/'.join(locks)} in "
+                    f"{'/'.join(sorted(other_guarded))} but "
+                    f"{kind} lock-free in {mm.qualname} — a torn-state "
+                    "race once those methods run on different threads. "
+                    "Take the lock around this access, or mark a "
+                    "provably benign pattern with "
+                    "`# jaxlint: disable=JL020 reason=...`."
+                ),
+            )
+
+
+def rule_jl021(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL021: blocking call while holding a lock — future.result,
+    Event.wait, queue get/put, socket/HTTP send, subprocess, sleep, or
+    a registry/XLA compile inside a ``with self._lock:`` scope (or a
+    helper that inherits the lock at entry). Every other thread that
+    touches the lock convoys behind the slow call; if the blocked-on
+    resource needs the same lock to make progress, it is a deadlock.
+    ``Condition.wait`` on the held lock releases it while parked and is
+    exempt; ``SimpleQueue.put`` cannot block and is exempt. Deliberate
+    holds (the registry's serialize-all-compiles lock) get
+    ``# jaxlint: disable=JL021 reason=...``.
+    """
+    if not _concurrency_in_scope(mod):
+        return
+    model = _conc_model(mod)
+    for cls in sorted(model.classes.values(), key=lambda c: c.lineno):
+        for mm in sorted(cls.methods.values(), key=lambda m: m.lineno):
+            for b in mm.blocking:
+                eff = set(b.locks) | set(mm.entry_locks)
+                if not eff:
+                    continue
+                locks = "/".join(sorted(eff))
+                yield Finding(
+                    rule="JL021",
+                    path=mod.path,
+                    line=b.lineno,
+                    context=mm.qualname,
+                    detail=f"{b.desc} under {locks}",
+                    message=(
+                        f"{mm.qualname} makes a blocking call "
+                        f"({b.desc}) while holding {locks}: every "
+                        "thread touching that lock convoys behind it, "
+                        "and a dependency back onto the lock deadlocks. "
+                        "Move the call outside the critical section, or "
+                        "mark a deliberate serialization point with "
+                        "`# jaxlint: disable=JL021 reason=...`."
+                    ),
+                )
+
+
+def rule_jl022(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL022: lock-order cycle — nested acquisitions in source order
+    (``with self._a:`` inside ``with self._b:``, helper call-through,
+    and cross-class call-through on typed attributes) are edges in the
+    lock-order graph; a cycle is a latent deadlock regardless of
+    schedule luck. The module-local graph is checked here; the
+    program-wide graph is built by ``python -m
+    speakingstyle_tpu.analysis.cli lockorder --write`` into
+    analysis/lockorder.json, which ``--check`` keeps fresh and the
+    runtime TrackedLock witness (obs/locks.py) enforces.
+    """
+    if not _concurrency_in_scope(mod):
+        return
+    from speakingstyle_tpu.analysis import concurrency
+
+    model = _conc_model(mod)
+    edges = concurrency.lock_edges([model])
+    cycle = concurrency.find_cycle(edges)
+    if cycle is not None:
+        first = edges.get((cycle[0], cycle[1]), ["?"])[0]
+        line = 1
+        if ":" in first:
+            try:
+                line = int(first.split(" ")[0].rsplit(":", 1)[1])
+            except ValueError:
+                pass
+        yield Finding(
+            rule="JL022",
+            path=mod.path,
+            line=line,
+            context="<module>",
+            detail="lock-order cycle " + " -> ".join(cycle),
+            message=(
+                "lock-order cycle within this module: "
+                + " -> ".join(cycle)
+                + " — two threads taking the locks in opposite orders "
+                "deadlock. Break the cycle (acquire in one global "
+                "order, or drop the lock before the cross call); the "
+                "checked-in order lives in analysis/lockorder.json."
+            ),
+        )
+
+
+def rule_jl023(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL023: unsupervised thread — ``threading.Thread(...)`` with no
+    ``name=`` (anonymous in stack dumps, watchdog output, and the
+    lock-witness acquisition records), or a thread-creating class with
+    no shutdown path: no method that ``.join()``s a thread or sets a
+    stop Event. Serving threads must be both identifiable and
+    collectable — the PR 9 watchdog and every drain path assume it.
+    """
+    if not _concurrency_in_scope(mod):
+        return
+    model = _conc_model(mod)
+    sites = []
+    for cls in sorted(model.classes.values(), key=lambda c: c.lineno):
+        sites.extend(cls.thread_sites)
+    sites.extend(model.module_thread_sites)
+    for lineno, has_name, target, method in sorted(sites):
+        if has_name:
+            continue
+        tgt = f" (target {target})" if target else ""
+        yield Finding(
+            rule="JL023",
+            path=mod.path,
+            line=lineno,
+            context=method,
+            detail=f"unnamed thread in {method}",
+            message=(
+                f"threading.Thread created without name= in {method}"
+                f"{tgt}: anonymous threads are invisible to watchdog "
+                "stacks, the lock witness, and py-spy output — name it "
+                "after its role (e.g. name=f\"replica-{i}-dispatch\")."
+            ),
+        )
+    for cls in sorted(model.classes.values(), key=lambda c: c.lineno):
+        if not cls.thread_sites:
+            continue
+        joins = False
+        signals = False
+        for mm in cls.methods.values():
+            for recv, meth, _, _ in mm.local_calls:
+                if meth == "join":
+                    joins = True
+            for attr, owner_tag, meth, _, _ in mm.attr_calls:
+                if meth == "join":
+                    joins = True
+                if meth == "set" and owner_tag == "self" and \
+                        cls.attr_kinds.get(attr) == "event":
+                    signals = True
+        if joins or signals:
+            continue
+        yield Finding(
+            rule="JL023",
+            path=mod.path,
+            line=cls.lineno,
+            context=cls.name,
+            detail=f"{cls.name} never joins/stops its threads",
+            message=(
+                f"{cls.name} creates threads but no method joins them "
+                "or sets a stop Event: the thread outlives close()/"
+                "drain and is invisible to shutdown supervision. Join "
+                "it (or signal a stop Event the worker loop polls) on "
+                "the close()/stop() path."
+            ),
+        )
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -2142,4 +2436,8 @@ RULES = {
     "JL017": rule_jl017,
     "JL018": rule_jl018,
     "JL019": rule_jl019,
+    "JL020": rule_jl020,
+    "JL021": rule_jl021,
+    "JL022": rule_jl022,
+    "JL023": rule_jl023,
 }

@@ -359,7 +359,9 @@ def main(args):
     # shutdown() must run off the serve_forever thread.
     def _sigterm(signum, frame):
         print("SIGTERM: draining in-flight streams ...", flush=True)
-        threading.Thread(target=server.shutdown, daemon=True).start()
+        threading.Thread(
+            target=server.shutdown, name="server-shutdown", daemon=True
+        ).start()
 
     signal.signal(signal.SIGTERM, _sigterm)
 

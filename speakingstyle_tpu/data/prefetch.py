@@ -90,7 +90,9 @@ class DevicePrefetcher:
         )
         self._stopped = threading.Event()
         self._finished = False
-        self.thread = threading.Thread(target=self._worker, daemon=True)
+        self.thread = threading.Thread(
+            target=self._worker, name="prefetch-worker", daemon=True
+        )
         self.thread.start()
 
     def _put(self, batch: Batch):

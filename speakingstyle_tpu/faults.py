@@ -66,6 +66,7 @@ import dataclasses
 import os
 import threading
 from typing import List, Sequence, Tuple
+from speakingstyle_tpu.obs.locks import make_lock
 
 ENV_VAR = "SPEAKINGSTYLE_FAULTS"
 
@@ -90,7 +91,7 @@ class FaultPlan:
 
     def __init__(self, faults: Sequence[_Fault] = ()):
         self._faults: List[_Fault] = list(faults)
-        self._lock = threading.Lock()
+        self._lock = make_lock("FaultPlan._lock")
 
     @classmethod
     def parse(cls, spec: str) -> "FaultPlan":

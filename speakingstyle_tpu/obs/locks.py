@@ -1,0 +1,254 @@
+"""Runtime lock-order witness: the dynamic half of jaxlint JL022.
+
+``analysis/lockorder.json`` is the *static* claim — "these are all the
+lock nestings in the tree, and this total order is consistent with
+them".  This module checks the claim against reality: under
+``SPEAKINGSTYLE_CHECKS=1``, ``make_lock(name, ...)`` returns a
+``TrackedLock`` that
+
+  * keeps a per-thread stack of currently-held tracked locks,
+  * raises ``LockOrderError`` the moment a thread acquires a lock that
+    sits *earlier* in the committed order than one it already holds
+    (the inversion that, interleaved with another thread doing the
+    opposite, becomes a deadlock),
+  * exports ``lock_hold_seconds{lock=}`` histograms and
+    ``lock_contention_total{lock=}`` counters through the process
+    MetricsRegistry so the chaos/storm drills can put a p999 bound on
+    critical-section length.
+
+With checks off (the default), ``make_lock`` returns the plain
+``threading`` primitive — zero overhead, zero behavior change.  Lock
+names are ``"ClassName._attr"``, the same spelling the static model
+uses, so a runtime inversion report and the lockorder.json evidence
+point at the same objects.
+
+The obs-internal locks (MetricsRegistry, Counter, ...) deliberately
+stay plain: the witness records its findings *through* the registry,
+and tracking the registry's own lock would recurse.
+"""
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+__all__ = [
+    "LockOrderError",
+    "TrackedLock",
+    "make_lock",
+    "checks_enabled",
+    "lock_order",
+]
+
+_HOLD_BUCKETS = (
+    0.000001, 0.00001, 0.0001, 0.001, 0.01, 0.1, 1.0, 10.0,
+)
+
+
+def checks_enabled() -> bool:
+    return os.environ.get("SPEAKINGSTYLE_CHECKS", "") == "1"
+
+
+class LockOrderError(RuntimeError):
+    """A thread acquired locks against the committed static order."""
+
+
+# per-thread stack of (name, order-position) for held tracked locks;
+# shared by every TrackedLock so cross-class nesting is visible
+_held = threading.local()
+
+
+def _stack() -> List[tuple]:
+    s = getattr(_held, "stack", None)
+    if s is None:
+        s = _held.stack = []
+    return s
+
+
+_order_cache: Optional[Dict[str, int]] = None
+_order_lock = threading.Lock()
+
+
+def lock_order(path: Optional[str] = None) -> Dict[str, int]:
+    """{lock name: position} from the committed lockorder.json.  Missing
+    or unreadable artifact -> empty mapping (every lock unconstrained):
+    the witness degrades to metrics-only rather than breaking serving.
+    """
+    global _order_cache
+    if path is None and _order_cache is not None:
+        return _order_cache
+    if path is None:
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = os.path.join(here, "analysis", "lockorder.json")
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            data = json.load(fh)
+        order = {name: i for i, name in enumerate(data.get("order", []))}
+    except (OSError, ValueError):
+        order = {}
+    with _order_lock:
+        if _order_cache is None:
+            _order_cache = order
+    return order
+
+
+def _reset_order_cache() -> None:
+    """Test hook: forget the cached artifact."""
+    global _order_cache
+    with _order_lock:
+        _order_cache = None
+
+
+class TrackedLock:
+    """Order-checking, metrics-exporting wrapper over one ``threading``
+    primitive.  Context-manager compatible; Condition extras
+    (``wait``/``wait_for``/``notify``/``notify_all``) delegate, with
+    ``wait`` treated as a release+reacquire so hold timing and the
+    order stack stay truthful across the blocked span.
+    """
+
+    def __init__(self, name: str, kind: str = "lock", registry=None,
+                 order: Optional[Dict[str, int]] = None):
+        if kind == "lock":
+            self._inner = threading.Lock()
+        elif kind == "rlock":
+            self._inner = threading.RLock()
+        elif kind == "condition":
+            self._inner = threading.Condition()
+        else:
+            raise ValueError(f"unknown lock kind {kind!r}")
+        self.name = name
+        self.kind = kind
+        self._order = lock_order() if order is None else order
+        self._pos = self._order.get(name)   # None: unconstrained
+        self._reentry = threading.local()
+        if registry is None:
+            from speakingstyle_tpu.obs.registry import get_registry
+            registry = get_registry()
+        labels = {"lock": name}
+        self._hold_hist = registry.histogram(
+            "lock_hold_seconds", edges=_HOLD_BUCKETS, labels=labels,
+            help="wall seconds a tracked lock was held per acquisition",
+        )
+        self._contention = registry.counter(
+            "lock_contention_total", labels=labels,
+            help="acquisitions that had to wait for another holder",
+        )
+        self._inversions = registry.counter(
+            "lock_order_inversions_total",
+            help="runtime acquisitions violating analysis/lockorder.json",
+        )
+
+    # -- acquisition bookkeeping -------------------------------------
+
+    def _depth(self) -> int:
+        return getattr(self._reentry, "depth", 0)
+
+    def _check_order(self) -> None:
+        if self._pos is None:
+            return
+        for held_name, held_pos in _stack():
+            if held_pos is not None and held_pos > self._pos:
+                self._inversions.inc()
+                raise LockOrderError(
+                    f"lock order inversion: acquiring {self.name!r} "
+                    f"(position {self._pos}) while holding "
+                    f"{held_name!r} (position {held_pos}); committed "
+                    "order is analysis/lockorder.json"
+                )
+
+    def _note_acquired(self) -> None:
+        self._reentry.depth = self._depth() + 1
+        if self._reentry.depth == 1:
+            _stack().append((self.name, self._pos))
+            self._reentry.t0 = time.perf_counter()
+
+    def _note_released(self) -> None:
+        depth = self._depth()
+        if depth <= 0:
+            return   # release() without acquire(): let _inner raise
+        self._reentry.depth = depth - 1
+        if self._reentry.depth == 0:
+            self._hold_hist.observe(
+                time.perf_counter() - self._reentry.t0
+            )
+            stack = _stack()
+            for i in range(len(stack) - 1, -1, -1):
+                if stack[i][0] == self.name:
+                    del stack[i]
+                    break
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        reentrant = self.kind == "rlock" and self._depth() > 0
+        if not reentrant:
+            self._check_order()
+        if blocking and not self._inner.acquire(False):
+            self._contention.inc()
+            got = self._inner.acquire(True, timeout)
+        else:
+            got = True if blocking else self._inner.acquire(False)
+        if got:
+            self._note_acquired()
+        return got
+
+    def release(self) -> None:
+        self._note_released()
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        try:
+            return self._inner.locked()
+        except AttributeError:   # Condition pre-3.12 lacks locked()
+            if self._inner.acquire(False):
+                self._inner.release()
+                return False
+            return True
+
+    # -- Condition protocol ------------------------------------------
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        # Condition.wait releases the underlying lock for the blocked
+        # span: mirror that in the stack + hold metric, then restore
+        self._note_released()
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            self._note_acquired()
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        self._note_released()
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            self._note_acquired()
+
+    def notify(self, n: int = 1) -> None:
+        self._inner.notify(n)
+
+    def notify_all(self) -> None:
+        self._inner.notify_all()
+
+
+def make_lock(name: str, kind: str = "lock", registry=None):
+    """A named lock: plain ``threading`` primitive normally, a
+    ``TrackedLock`` under ``SPEAKINGSTYLE_CHECKS=1``.  ``name`` must be
+    the static model's ``"ClassName._attr"`` spelling so the runtime
+    witness and ``lockorder.json`` agree on identity.
+    """
+    if not checks_enabled():
+        if kind == "lock":
+            return threading.Lock()
+        if kind == "rlock":
+            return threading.RLock()
+        if kind == "condition":
+            return threading.Condition()
+        raise ValueError(f"unknown lock kind {kind!r}")
+    return TrackedLock(name, kind=kind, registry=registry)

@@ -53,6 +53,7 @@ import contextlib
 import functools
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
+from speakingstyle_tpu.obs.locks import make_lock
 
 __all__ = [
     "ProgramRegistry",
@@ -178,7 +179,7 @@ class ProgramRegistry:
             counter_name,
             help="XLA programs compiled through this ProgramRegistry",
         )
-        self._lock = threading.RLock()
+        self._lock = make_lock("ProgramRegistry._lock", kind="rlock")
         self._programs: Dict[Tuple, Any] = {}
         self._by_name: Dict[str, Any] = {}
         self._cards: List[Dict] = []
@@ -266,8 +267,10 @@ class ProgramRegistry:
             with quiet_donation():
                 lowered = jitted.lower(*args)
                 exe = (
+                    # jaxlint: disable=JL021 reason=the registry lock deliberately serializes all XLA compiles; this is the one sanctioned compile entry point
                     lowered.compile(compiler_options=compiler_options)
                     if compiler_options
+                    # jaxlint: disable=JL021 reason=the registry lock deliberately serializes all XLA compiles; this is the one sanctioned compile entry point
                     else lowered.compile()
                 )
             self._compiles.inc()

@@ -38,6 +38,7 @@ from speakingstyle_tpu.serving.engine import (
     bucket_label,
 )
 from speakingstyle_tpu.serving.resilience import DispatchError
+from speakingstyle_tpu.obs.locks import make_lock
 
 
 class ShutdownError(RuntimeError):
@@ -73,7 +74,7 @@ class DrainRateEstimator:
 
     def __init__(self, window_s: float = 5.0):
         self.window_s = float(window_s)
-        self._lock = threading.Lock()
+        self._lock = make_lock("DrainRateEstimator._lock")
         self._events: "deque" = deque()  # (monotonic stamp, n completed)
 
     def note(self, n: int = 1, now: Optional[float] = None) -> None:
@@ -148,9 +149,9 @@ class ContinuousBatcher:
         self._retry_after = fleet.shed_retry_after_s if fleet else 1.0
         self.drain_rate = DrainRateEstimator()
         self._shedding = False
-        self._shed_lock = threading.Lock()
+        self._shed_lock = make_lock("ContinuousBatcher._shed_lock")
         self._stopped = threading.Event()
-        self._closed_lock = threading.Lock()
+        self._closed_lock = make_lock("ContinuousBatcher._closed_lock")
         self._terminal_sent = False
         # observability: everything lives in the registry (obs/), which
         # /metrics, /healthz, and bench.py all read from one snapshot —

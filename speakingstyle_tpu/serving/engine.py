@@ -88,6 +88,7 @@ from speakingstyle_tpu.serving.pool import BufferPool
 from speakingstyle_tpu.serving.resilience import InjectedFault
 from speakingstyle_tpu.serving.style import StyleService, StyleVectors
 from speakingstyle_tpu.training.resilience import retry_io
+from speakingstyle_tpu.obs.locks import make_lock
 
 __all__ = [
     "CompileMonitor",  # re-export: historical home before obs/jaxmon.py
@@ -308,13 +309,13 @@ class SynthesisEngine:
         # registry lock for its achieved-FLOP/s arithmetic
         self._acoustic_flops: Dict[Bucket, Optional[float]] = {}
         self._vocoder_flops: Dict[Tuple[int, int], Optional[float]] = {}
-        self._lock = threading.Lock()  # compile-on-miss exclusion
+        self._lock = make_lock("SynthesisEngine._lock")  # compile-on-miss exclusion
         self.fault_plan = fault_plan
         # vocoder_raise@N indexes this 1-based call counter; an int (not
         # itertools.count) so chaos drills can read ``vocode_calls`` and
         # arm a live plan at the NEXT call
         self._vocode_calls = 0
-        self._vocode_calls_lock = threading.Lock()
+        self._vocode_calls_lock = make_lock("SynthesisEngine._vocode_calls_lock")
         self._style_degraded_ctr = self.registry.counter(
             "serve_style_degraded_total",
             help="requests whose style fell back to the default (all-zero "

@@ -72,6 +72,7 @@ from speakingstyle_tpu.serving.engine import (
     bucket_label,
 )
 from speakingstyle_tpu.serving.lattice import BucketLattice, StyleLattice
+from speakingstyle_tpu.obs.locks import make_lock
 from speakingstyle_tpu.serving.resilience import (
     CircuitBreaker,
     DeadlineExceeded,
@@ -177,7 +178,7 @@ class FleetRouter:
         self.max_wait = serve.max_wait_ms / 1e3
         self._frames_per_phoneme = serve.frames_per_phoneme
 
-        self._cond = threading.Condition()
+        self._cond = make_lock("FleetRouter._cond", kind="condition")
         self._heap: List[_Pending] = []
         self._seq = 0
         self._closing = False
@@ -319,10 +320,13 @@ class FleetRouter:
             if rep.state != COLD:   # shrunk away before warm-up began
                 return
             self._set_state(rep, WARMING)
-        t0 = time.monotonic()
-        try:
+            # capture the per-replica factory while still holding the
+            # lock: a concurrent rollout may stamp rep.factory from the
+            # control thread, and this read must see a settled value
             factory = rep.factory if rep.factory is not None \
                 else self.engine_factory
+        t0 = time.monotonic()
+        try:
             engine = factory(self.registry)
             secs = engine.precompile()
             self.registry.gauge(
@@ -332,8 +336,8 @@ class FleetRouter:
             ).set(secs)
             self._warmup_hist.observe(time.monotonic() - t0)
         except BaseException as e:
-            rep.error = e
             with self._cond:
+                rep.error = e
                 if rep.breaker.state == "half_open":
                     # a re-warm trial failed: re-open the breaker with a
                     # doubled backoff and try again later, instead of
@@ -356,11 +360,17 @@ class FleetRouter:
             rep.generation += 1       # orphan any worker from a past life
             gen = rep.generation
             self._set_state(rep, READY)
-        rep.worker = threading.Thread(
-            target=self._worker, args=(rep, gen),
-            name=f"replica-{rep.index}-dispatch", daemon=True,
-        )
-        rep.worker.start()
+            # publish AND start the worker under the lock: close() joins
+            # every non-None rep.worker, and join() on a never-started
+            # thread raises, so the handle must not be visible before
+            # start().  The worker's first acquire of _cond just blocks
+            # until this block releases.
+            worker = threading.Thread(
+                target=self._worker, args=(rep, gen),
+                name=f"replica-{rep.index}-dispatch", daemon=True,
+            )
+            worker.start()
+            rep.worker = worker
 
     def states(self) -> Dict[int, str]:
         with self._cond:
@@ -746,7 +756,8 @@ class FleetRouter:
             self._claim(rep, batch)   # nothing left to run: release it
             return True
         req_ids = [p.request.id for p in batch]
-        n = rep.dispatch_n        # stamped under the lock in _collect
+        # jaxlint: disable=JL020 reason=stamped under _cond in _collect by this same single dispatch worker
+        n = rep.dispatch_n
         t0 = time.monotonic()
         for p in batch:
             self._queue_wait_hist.observe(t0 - p.request.arrival)
@@ -762,6 +773,7 @@ class FleetRouter:
                     time.sleep(
                         3.0 * self._watchdog if self._watchdog > 0 else 0.5
                     )
+            # jaxlint: disable=JL020 reason=engine set under _cond before this generation's worker starts and never reassigned within a generation
             results = rep.engine.run([p.request for p in batch])
         except BaseException as e:
             if not self._claim(rep, batch):

@@ -49,6 +49,7 @@ from typing import Dict, Optional
 from speakingstyle_tpu.obs import JsonlEventLog, MetricsRegistry
 from speakingstyle_tpu.obs.trace import Span
 from speakingstyle_tpu.serving.batcher import ShutdownError
+from speakingstyle_tpu.obs.locks import make_lock
 
 __all__ = ["PendingRequest", "FrontendPool", "RESOLVE_TIMEOUT_S"]
 
@@ -122,7 +123,7 @@ class FrontendPool:
         # queue_depth watermark — depth here can never exceed that bound
         self._queue: "queue.SimpleQueue" = queue.SimpleQueue()  # jaxlint: disable=JL011
         self._closed = False
-        self._close_lock = threading.Lock()
+        self._close_lock = make_lock("FrontendPool._close_lock")
         self._hist = self.registry.histogram(
             "serve_frontend_seconds",
             help="per-request frontend cost (normalize + G2P + style "

@@ -51,6 +51,7 @@ import numpy as np
 
 from speakingstyle_tpu.serving.engine import SynthesisRequest
 from speakingstyle_tpu.serving.fleet import READY, STOPPED
+from speakingstyle_tpu.obs.locks import make_lock
 
 __all__ = ["RolloutInProgress", "RolloutManager", "make_golden_set"]
 
@@ -103,7 +104,7 @@ class RolloutManager:
         self.registry = registry if registry is not None else router.registry
         self.rcfg = rcfg if rcfg is not None else router.cfg.serve.rollout
         self.golden = golden
-        self._lock = threading.Lock()
+        self._lock = make_lock("RolloutManager._lock")
 
     # -- observability -------------------------------------------------------
 

@@ -71,6 +71,7 @@ from speakingstyle_tpu.serving.engine import (
 )
 from speakingstyle_tpu.serving.lattice import BucketLattice, RequestTooLarge
 from speakingstyle_tpu.serving.resilience import InjectedFault
+from speakingstyle_tpu.obs.locks import make_lock
 
 __all__ = [
     "split_sentences",
@@ -353,7 +354,7 @@ class RingTier:
             "src" if pp.energy.feature == "phoneme_level" else "mel"
         )
         self._programs: Dict[object, object] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("RingTier._lock")
         self._ring_hist = self.registry.histogram(
             "serve_longform_ring_seconds",
             help="wall time of one ring-attention chapter free-run "
@@ -620,7 +621,7 @@ class LongformService:
         else:
             self.klass = fleet.default_class
         self._ring_attempts = 0
-        self._ring_lock = threading.Lock()
+        self._ring_lock = make_lock("LongformService._ring_lock")
         self._chunks_ctr = self.registry.counter(
             "serve_longform_chunks_total",
             help="chapter chunks synthesized by the chunked tier",

@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from speakingstyle_tpu.obs import MetricsRegistry
+from speakingstyle_tpu.obs.locks import make_lock
 
 __all__ = ["BufferPool"]
 
@@ -47,7 +48,7 @@ class BufferPool:
 
     def __init__(self, registry: Optional[MetricsRegistry] = None):
         self.registry = registry if registry is not None else MetricsRegistry()
-        self._lock = threading.Lock()
+        self._lock = make_lock("BufferPool._lock")
         self._free: Dict[_Key, List[np.ndarray]] = {}
         # id(buf) -> (key, buf): holds the lease reference (keeps the id
         # stable) and lets release() find the free-list without trusting

@@ -37,6 +37,7 @@ one raises.
 """
 
 import threading
+from speakingstyle_tpu.obs.locks import make_lock
 
 # serve_replica_breaker_state gauge values, mirroring fleet.STATE_CODE.
 BREAKER_CODE = {"closed": 0, "open": 1, "half_open": 2}
@@ -87,7 +88,7 @@ class CircuitBreaker:
             )
         self._base = float(backoff_s)
         self._max = float(backoff_max_s)
-        self._lock = threading.Lock()
+        self._lock = make_lock("CircuitBreaker._lock")
         self._state = "closed"
         self._consecutive = 0
         self._retry_at = 0.0

@@ -103,6 +103,7 @@ from speakingstyle_tpu.serving.batcher import (
 from speakingstyle_tpu.serving.engine import SynthesisEngine, SynthesisRequest
 from speakingstyle_tpu.serving.frontend import FrontendPool
 from speakingstyle_tpu.serving.lattice import RequestTooLarge
+from speakingstyle_tpu.obs.locks import make_lock
 from speakingstyle_tpu.serving.resilience import (
     DeadlineExceeded,
     DispatchError,
@@ -497,7 +498,7 @@ class SynthesisServer:
             self.cfg.train.path.log_path, "serve_profile"
         )
         # in-flight chunked streams, drained before shutdown completes
-        self._streams_cond = threading.Condition()
+        self._streams_cond = make_lock("SynthesisServer._streams_cond", kind="condition")
         self._active_streams = 0
         self._streams_gauge = self.registry.gauge(
             "serve_active_streams", help="chunked streams currently emitting"
@@ -507,9 +508,9 @@ class SynthesisServer:
             help="request arrival -> first streamed wav chunk ready",
         )
         self._stream_overlap: Optional[int] = None
-        self._shutdown_lock = threading.Lock()
+        self._shutdown_lock = make_lock("SynthesisServer._shutdown_lock")
         self._shut_down = False
-        self._profile_lock = threading.Lock()  # one capture at a time
+        self._profile_lock = make_lock("SynthesisServer._profile_lock")  # one capture at a time
         # the request-id sequence IS the request counter: Counter.inc()
         # returns the post-increment value under the metric's own lock,
         # so there is no separate _req_counter to keep in sync
@@ -1242,6 +1243,7 @@ class SynthesisServer:
             trace_dir = os.path.join(self.profile_dir, f"capture_{seq:04d}")
             os.makedirs(trace_dir, exist_ok=True)
             jax.profiler.start_trace(trace_dir)
+            # jaxlint: disable=JL021 reason=_profile_lock is a capture latch not a data lock; the sleep IS the capture window and contenders get a non-blocking refusal
             time.sleep(seconds)
             jax.profiler.stop_trace()
         finally:

@@ -59,6 +59,7 @@ from speakingstyle_tpu.parallel.registry import ProgramRegistry
 from speakingstyle_tpu.serving.lattice import StyleLattice
 from speakingstyle_tpu.serving.pool import BufferPool
 from speakingstyle_tpu.serving.resilience import InjectedFault
+from speakingstyle_tpu.obs.locks import make_lock
 
 __all__ = [
     "StyleService",
@@ -220,13 +221,13 @@ class StyleService:
         # int (not itertools.count) so chaos drills can read
         # ``encode_attempts`` and arm a live plan at the NEXT attempt
         self._encode_attempts = 0
-        self._attempts_lock = threading.Lock()
+        self._attempts_lock = make_lock("StyleService._attempts_lock")
         self._capacity = cfg.serve.style.cache_capacity
         self._entries: "OrderedDict[str, StyleVectors]" = OrderedDict()
         self._seq = 0
-        self._cache_lock = threading.Lock()
+        self._cache_lock = make_lock("StyleService._cache_lock")
         self._exe: Dict[Tuple[int, int], object] = {}
-        self._compile_lock = threading.Lock()
+        self._compile_lock = make_lock("StyleService._compile_lock")
         # encoder-dispatch staging rides the same pooled-buffer
         # discipline as the synthesis engine (serving/pool.py)
         self.pool = BufferPool(registry=self.registry)
@@ -306,6 +307,7 @@ class StyleService:
             in_sh = (self._repl_sharding, bsh, bsh)
             out_sh = bsh
         label = style_bucket_label(point)
+        # jaxlint: disable=JL021 reason=_compile_lock exists precisely to serialize style-encoder compiles; callers are warm-up paths
         self._exe[point] = self.program_registry.compile(
             self._encode_fn(r),
             (

@@ -55,6 +55,7 @@ import orbax.checkpoint as ocp
 
 from speakingstyle_tpu.obs.buildinfo import array_sha256, weights_digest
 from speakingstyle_tpu.training.state import TrainState
+from speakingstyle_tpu.obs.locks import make_lock
 
 MANIFEST_NAME = "manifest.json"
 MANIFEST_FORMAT = 1
@@ -130,7 +131,7 @@ class CheckpointManager:
         self.keep_best = keep_best
         self.async_save = async_save
         self._metrics: Dict[int, float] = {}  # step -> val loss
-        self._lock = threading.Lock()
+        self._lock = make_lock("CheckpointManager._lock")
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self.fault_plan = fault_plan

@@ -21,6 +21,7 @@ import signal
 import threading
 import time
 from typing import Callable, Dict, Optional, Tuple
+from speakingstyle_tpu.obs.locks import make_lock
 
 
 class TrainingDivergedError(RuntimeError):
@@ -81,7 +82,7 @@ class Quarantine:
     def __init__(self, budget: int = 16):
         self.budget = budget
         self.bad: Dict[str, str] = {}  # sample id -> error summary
-        self._lock = threading.Lock()
+        self._lock = make_lock("Quarantine._lock")
 
     def add(self, sample_id: str, err: BaseException):
         with self._lock:
