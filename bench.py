@@ -1712,6 +1712,374 @@ def run_chaos(duration: float = 3.0, clients: int = 16,
     return point
 
 
+def _cluster_proxy_config(device_ms: float = 20.0):
+    """The cluster-drill config: the fleet CPU-proxy lattice with the
+    chaos drill's generous deadline budgets (the drill measures
+    control-plane supervision, not scheduling-induced expiry) plus the
+    cluster control-plane block — a short lease TTL (0.25 s beats, miss
+    budget 3 -> 1 s) so expiry-to-requeue is measurable inside a bench
+    phase, and a spawn grace wide enough for a child process to build +
+    AOT-precompile the tiny model on CPU."""
+    import dataclasses
+
+    from speakingstyle_tpu.configs.config import ClusterConfig, FleetConfig
+
+    cfg = _fleet_proxy_config()
+    return dataclasses.replace(cfg, serve=dataclasses.replace(
+        cfg.serve,
+        fleet=FleetConfig(
+            stream_window=8, queue_depth=256,
+            class_deadline_ms={"interactive": 30_000.0, "batch": 60_000.0},
+            rewarm_backoff_s=0.2, rewarm_backoff_max_s=5.0,
+        ),
+        cluster=ClusterConfig(
+            enabled=True,
+            heartbeat_interval_s=0.25,
+            lease_miss_budget=3,
+            connect_timeout_s=5.0,
+            spawn_grace_s=600.0,
+            quorum=2,
+            hedge_quantile=0.95,
+            hedge_min_ms=50.0,
+            hedge_max_ms=2000.0,
+        ),
+    ))
+
+
+def _cluster_replica_child(rid: str, router_addr: str,
+                           device_ms: float = 20.0):
+    """One replica PROCESS of the cluster drill: build the tiny proxy
+    engine, AOT-precompile the full lattice, transfer-warm every batch
+    bucket, and only then register + serve — the parent measures
+    spawn-to-lease as the warm-up cost, and a registered replica must
+    never compile under steady load."""
+    import os
+
+    import numpy as np
+
+    import jax
+
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.models.hifigan import Generator
+    from speakingstyle_tpu.obs import MetricsRegistry
+    from speakingstyle_tpu.serving.cluster import ReplicaServer
+    from speakingstyle_tpu.serving.engine import (
+        SynthesisEngine,
+        SynthesisRequest,
+    )
+
+    cfg = _cluster_proxy_config(device_ms)
+    serve = cfg.serve
+    _mark(f"[{rid}] building model parts")
+    n_position = max(serve.mel_buckets[-1], serve.src_buckets[-1],
+                     cfg.model.max_seq_len) + 1
+    model = build_model(cfg, n_position=n_position)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    gen = Generator(
+        upsample_rates=(2, 2), upsample_kernel_sizes=(4, 4),
+        upsample_initial_channel=16, resblock_kernel_sizes=(3,),
+        resblock_dilation_sizes=((1,),),
+    )
+    n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+    gparams = gen.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8, n_mels), np.float32)
+    )["params"]
+    registry = MetricsRegistry()
+    engine = ProxyDeviceEngine(
+        SynthesisEngine(cfg, variables, vocoder=(gen, gparams),
+                        model=model, registry=registry),
+        device_ms,
+    )
+    _mark(f"[{rid}] precompiling lattice")
+    engine.precompile()
+    rng = np.random.default_rng(0)
+    max_len = min(serve.src_buckets[-1],
+                  serve.mel_buckets[-1] // serve.frames_per_phoneme)
+    ref = rng.standard_normal(
+        (serve.style.ref_buckets[-1], n_mels)).astype(np.float32)
+    for b in engine.lattice.batch_buckets:
+        engine.run([
+            SynthesisRequest(
+                id=f"warm{b}_{j}",
+                sequence=rng.integers(1, 300, max_len).astype(np.int32),
+                ref_mel=ref, priority="batch",
+            )
+            for j in range(b)
+        ])
+    _mark(f"[{rid}] warm; registering with {router_addr}")
+    server = ReplicaServer(
+        engine, rid, router_addr, serve.cluster,
+        registry=registry, pid=os.getpid(),
+    )
+    server.start()
+    server.wait_closed()
+
+
+def run_cluster(duration: float = 3.0, clients: int = 16,
+                device_ms: float = 20.0):
+    """Cluster storm: three real replica PROCESSES behind the
+    ClusterRouter, a chaos process kill and a router<->replica partition
+    fired mid-storm, and an exact closed-loop loss count.
+
+    Four phases over one cluster: steady (per-replica compile counts
+    from each replica's own /healthz must not move), a kill storm
+    (``replica_proc_kill`` SIGKILLs a replica under load; its lease
+    expires, in-flight work requeues, the supervisor respawns a
+    process), a partition storm (``net_partition`` deterministically
+    drops router<->replica packets; heal re-admits the surviving
+    process through the breaker's half-open), and a postfault steady
+    phase. Every request is awaited, so lost is exact — the invariant
+    is ZERO. Lease-expiry-to-requeue latency is recorded from
+    ``serve_lease_requeue_seconds`` (p50/p999). CPU-proxy replicas
+    (``tiny-cpu-proxydev``): the numbers measure the control plane,
+    never device throughput.
+    """
+    from speakingstyle_tpu.faults import FaultPlan
+    from speakingstyle_tpu.obs import MetricsRegistry
+    from speakingstyle_tpu.serving.batcher import Overloaded
+    from speakingstyle_tpu.serving.cluster import ClusterRouter
+    from speakingstyle_tpu.serving.engine import SynthesisRequest
+    from speakingstyle_tpu.serving.fleet import FAILED, READY
+
+    import numpy as np
+
+    label = "tiny-cpu-proxydev"
+    cfg = _cluster_proxy_config(device_ms)
+    serve = cfg.serve
+    here = os.path.dirname(os.path.abspath(__file__))
+    rng = np.random.default_rng(0)
+    max_len = min(serve.src_buckets[-1],
+                  serve.mel_buckets[-1] // serve.frames_per_phoneme)
+    n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+    max_ref = serve.style.ref_buckets[-1]
+    hot_refs = [
+        rng.standard_normal(
+            (int(rng.integers(8, max_ref + 1)), n_mels)
+        ).astype(np.float32)
+        for _ in range(8)
+    ]
+
+    def make_request(i: int, priority: str) -> SynthesisRequest:
+        L = int(rng.integers(max(4, max_len // 2), max_len + 1))
+        return SynthesisRequest(
+            id=f"cluster{i}",
+            sequence=rng.integers(1, 300, L).astype(np.int32),
+            ref_mel=hot_refs[i % len(hot_refs)],
+            priority=priority,
+        )
+
+    logs = []
+
+    def spawn(rid, router_addr, extra):
+        # children are pinned to CPU regardless of the parent's backend:
+        # this drill measures the control plane over a CPU proxy, and
+        # three children grabbing one accelerator would fight over it
+        log = open(os.path.join(here, f".bench_cluster_{rid}.log"), "w")
+        logs.append(log)
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--cluster-replica-inner", "--rid", rid,
+             "--router", router_addr, "--device-ms", str(device_ms)],
+            stdout=log, stderr=log, cwd=here,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+
+    registry = MetricsRegistry()
+    plan = FaultPlan()
+    _mark("spawning 3 cluster replica processes")
+    router = ClusterRouter(spawn, cfg, replicas=3, registry=registry,
+                           fault_plan=plan)
+    point = {
+        "metric": "serve_cluster", "replicas": 3, "clients": clients,
+        "proxy_device_ms": device_ms, "model": label,
+    }
+    try:
+        if not router.wait_ready(timeout=600, n=3):
+            point["error"] = "replica processes never became ready"
+            print(json.dumps(point))
+            return point
+
+        def compile_counts():
+            """{replica_id: its own /healthz compile counter} for every
+            attached remote engine (-1/unreachable rows are dropped)."""
+            out = {}
+            for rep in router._replicas:
+                eng = rep.engine
+                rid = getattr(eng, "replica_id", "")
+                if rid:
+                    c = eng.compile_count
+                    if c >= 0:
+                        out[rid] = c
+            return out
+
+        def load_phase(phase_s: float, seed: int):
+            stop_at = time.perf_counter() + phase_s
+            per = [dict(ok=0, shed=0, lost=0, errors=[])
+                   for _ in range(clients)]
+
+            def client(cid: int):
+                c, i = per[cid], 0
+                while time.perf_counter() < stop_at:
+                    prio = "interactive" if (cid + i) % 2 == 0 else "batch"
+                    req = make_request(seed + cid * 1_000_000 + i, prio)
+                    try:
+                        router.submit(req).result(timeout=120)
+                        c["ok"] += 1
+                    except Overloaded:
+                        c["shed"] += 1
+                        time.sleep(0.002)
+                    except Exception as e:
+                        c["lost"] += 1
+                        c["errors"].append(type(e).__name__)
+                    i += 1
+
+            threads = [threading.Thread(target=client, args=(c,),
+                                        daemon=True)
+                       for c in range(clients)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            dt = time.perf_counter() - t0
+            out = {k: sum(c[k] for c in per)
+                   for k in ("ok", "shed", "lost")}
+            out["errors"] = sorted({e for c in per for e in c["errors"]})
+            out["qps"] = out["ok"] / dt
+            return out
+
+        def drill(kind: str, seed: int):
+            """Arm ``kind`` on the next dispatch (quiesced, so the
+            counter cannot be raced past), run one storm phase, then
+            wait the fleet back to 3 READY.  Returns (phase, recovery
+            ms) — for a partition the heal happens after the storm, so
+            the recovery window includes the half-open re-admission."""
+            plan.arm(kind, router.dispatch_total + 1)
+            timeline = {}
+            stop_mon = threading.Event()
+
+            def monitor():
+                while not stop_mon.is_set():
+                    states = list(router.states().values())
+                    now = time.perf_counter()
+                    if FAILED in states and "t_failed" not in timeline:
+                        timeline["t_failed"] = now
+                    if ("t_failed" in timeline
+                            and "t_recovered" not in timeline
+                            and sum(s == READY for s in states) >= 3):
+                        timeline["t_recovered"] = now
+                        return
+                    time.sleep(0.002)
+
+            mon = threading.Thread(target=monitor, daemon=True)
+            mon.start()
+            phase = load_phase(duration, seed)
+            if kind == "net_partition":
+                # the storm ran against the partitioned control plane;
+                # now heal and let half-open adopt the process back
+                deadline = time.monotonic() + 120
+                while time.monotonic() < deadline \
+                        and not router._partitioned:
+                    time.sleep(0.05)
+                for rid in sorted(router._partitioned):
+                    router.heal(rid)
+            deadline = time.monotonic() + 600
+            while time.monotonic() < deadline \
+                    and "t_recovered" not in timeline:
+                time.sleep(0.05)
+            stop_mon.set()
+            mon.join(timeout=5)
+            recovery_ms = (
+                round(1e3 * (timeline["t_recovered"]
+                             - timeline["t_failed"]), 1)
+                if "t_recovered" in timeline and "t_failed" in timeline
+                else None
+            )
+            return phase, recovery_ms
+
+        _mark("cluster phase A: steady load")
+        pre_compiles = compile_counts()
+        steady = load_phase(duration, 0)
+        steady_deltas = {
+            rid: c - pre_compiles[rid]
+            for rid, c in compile_counts().items() if rid in pre_compiles
+        }
+
+        _mark("cluster phase B: replica process kill under load")
+        kill, kill_recovery_ms = drill("replica_proc_kill", 100_000_000)
+
+        _mark("cluster phase C: router<->replica partition under load")
+        part, part_recovery_ms = drill("net_partition", 200_000_000)
+
+        _mark("cluster phase D: postfault steady load")
+        post_pre = compile_counts()
+        postfault = load_phase(duration, 300_000_000)
+        post_deltas = {
+            rid: c - post_pre[rid]
+            for rid, c in compile_counts().items() if rid in post_pre
+        }
+
+        requeue = registry.histogram("serve_lease_requeue_seconds")
+
+        def pct_ms(hist, q):
+            p = hist.percentile(q)
+            return round(1e3 * p, 1) if p is not None else None
+
+        lost = (steady["lost"] + kill["lost"] + part["lost"]
+                + postfault["lost"])
+        hedge_fired = sum(
+            registry.value("serve_hedge_fired_total", {"class": k})
+            for k in ("interactive", "batch")
+        )
+        hedge_won = sum(
+            registry.value("serve_hedge_won_total", {"class": k})
+            for k in ("interactive", "batch")
+        )
+        point.update({
+            "steady_qps": round(steady["qps"], 2),
+            "kill_qps": round(kill["qps"], 2),
+            "partition_qps": round(part["qps"], 2),
+            "postfault_qps": round(postfault["qps"], 2),
+            "qps_recovery_ratio": (
+                round(postfault["qps"] / steady["qps"], 3)
+                if steady["qps"] else None
+            ),
+            "kill_recovery_ms": kill_recovery_ms,
+            "partition_recovery_ms": part_recovery_ms,
+            "lost_requests": lost,
+            "shed": (steady["shed"] + kill["shed"] + part["shed"]
+                     + postfault["shed"]),
+            "errors": sorted(set(
+                steady["errors"] + kill["errors"] + part["errors"]
+                + postfault["errors"]
+            )),
+            "lease_expired": int(
+                registry.value("serve_lease_expired_total")),
+            "lease_requeue_p50_ms": pct_ms(requeue, 0.50),
+            "lease_requeue_p999_ms": pct_ms(requeue, 0.999),
+            "requeued": int(registry.value("serve_requeued_total")),
+            "hedge_fired": int(hedge_fired),
+            "hedge_won": int(hedge_won),
+            # per-replica compile deltas across BOTH steady phases: the
+            # acceptance bar is zero on every surviving replica
+            "steady_compiles_per_replica": steady_deltas,
+            "postfault_compiles_per_replica": post_deltas,
+            "steady_compiles": int(
+                sum(steady_deltas.values()) + sum(post_deltas.values())
+            ),
+            **_lock_witness_stats(),
+        })
+        print(json.dumps(point))
+        return point
+    finally:
+        router.close()
+        for log in logs:
+            try:
+                log.close()
+            except OSError:
+                pass
+
+
 def run_rollout(duration: float = 3.0, clients: int = 16,
                 device_ms: float = 20.0):
     """Live-upgrade drill: a canary-gated rolling rollout under
@@ -3037,6 +3405,30 @@ def _absorb_record(rec, metrics):
         if isinstance(rec.get("lock_order_inversions"), (int, float)):
             metrics["chaos_lock_order_inversions"] = (
                 float(rec["lock_order_inversions"]), "lower")
+    elif m == "serve_cluster":
+        # the multi-process storm (real replica processes behind the
+        # ClusterRouter); cluster_lost_requests carries the hard zero
+        # gate in run_compare — a control plane that loses requests
+        # through a SIGKILL or a partition is broken, not 10% slower
+        for src, dst in (
+            ("lost_requests", "cluster_lost_requests"),
+            ("kill_recovery_ms", "cluster_kill_recovery_ms"),
+            ("partition_recovery_ms", "cluster_partition_recovery_ms"),
+            ("lease_requeue_p50_ms", "cluster_lease_requeue_p50_ms"),
+            ("lease_requeue_p999_ms", "cluster_lease_requeue_p999_ms"),
+            ("steady_compiles", "cluster_steady_compiles"),
+            ("shed", "cluster_shed"),
+            ("lock_hold_p999_max_s", "cluster_lock_hold_p999_max_s"),
+            ("lock_order_inversions", "cluster_lock_order_inversions"),
+        ):
+            if isinstance(rec.get(src), (int, float)):
+                metrics[dst] = (float(rec[src]), "lower")
+        for src, dst in (
+            ("steady_qps", "cluster_steady_qps"),
+            ("qps_recovery_ratio", "cluster_qps_recovery_ratio"),
+        ):
+            if isinstance(rec.get(src), (int, float)):
+                metrics[dst] = (float(rec[src]), "higher")
     elif m == "serve_rollout":
         # the live-upgrade drill; rollout_lost_requests carries the same
         # hard zero gate as chaos/traffic in run_compare — an upgrade
@@ -3193,6 +3585,17 @@ def run_compare(old_path, new_path=None, threshold=REGRESSION_THRESHOLD,
               "must reach a terminal state through flash + chaos + "
               "scale-down", file=out)
         return 1
+    # and for the cluster storm: a replica process SIGKILL or a
+    # router<->replica partition must resolve every in-flight request
+    # through lease expiry -> requeue (exactly-once via idempotency
+    # keys) — any loss is a control-plane bug, not a threshold matter
+    lost = new.get("cluster_lost_requests")
+    if lost is not None and lost[0] > 0:
+        print(f"FAIL: cluster storm lost {int(lost[0])} request(s) in "
+              f"{os.path.basename(new_path)}; lease expiry must requeue "
+              "every in-flight dispatch and idempotency keys must "
+              "dedupe hedged retries", file=out)
+        return 1
     # and for the live-upgrade drill: a model rollout is zero-downtime
     # by contract — any request lost through the swap fails the diff
     lost = new.get("rollout_lost_requests")
@@ -3325,6 +3728,7 @@ if __name__ == "__main__":
         run_chaos(duration=dur)
         run_traffic(duration=dur)
         run_rollout(duration=dur)
+        run_cluster(duration=dur)
         run_mesh_serve(duration=dur)
         run_longform(duration=dur)
     elif "--rollout" in sys.argv:
@@ -3343,6 +3747,17 @@ if __name__ == "__main__":
         dur = (float(sys.argv[sys.argv.index("--duration") + 1])
                if "--duration" in sys.argv else 3.0)
         run_chaos(duration=dur)
+    elif "--cluster-replica-inner" in sys.argv:
+        _cluster_replica_child(
+            sys.argv[sys.argv.index("--rid") + 1],
+            sys.argv[sys.argv.index("--router") + 1],
+            device_ms=(float(sys.argv[sys.argv.index("--device-ms") + 1])
+                       if "--device-ms" in sys.argv else 20.0),
+        )
+    elif "--cluster" in sys.argv:
+        dur = (float(sys.argv[sys.argv.index("--duration") + 1])
+               if "--duration" in sys.argv else 3.0)
+        run_cluster(duration=dur)
     elif "--fleet" in sys.argv:
         dur = (float(sys.argv[sys.argv.index("--duration") + 1])
                if "--duration" in sys.argv else 3.0)

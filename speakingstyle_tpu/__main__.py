@@ -14,6 +14,7 @@ COMMANDS = (
     "convert",
     "analyze",
     "serve",
+    "replica",
 )
 
 

@@ -116,6 +116,21 @@ JL023  unsupervised thread: ``threading.Thread(...)`` without a
        the thread or sets a stop Event. Scoped to speakingstyle_tpu/
        (bench/test harness threads are deliberately ad hoc).
        Tree baseline: zero.
+JL024  unbounded wire call in serving code: an HTTP/socket client
+       construct — http.client.HTTPConnection/HTTPSConnection,
+       urllib's urlopen, any requests.<verb>/requests.request, or
+       socket.create_connection — without an explicit ``timeout``
+       under speakingstyle_tpu/serving/. The distributed control
+       plane (serving/cluster.py) makes the serving tier a wire
+       *client*: dispatches, heartbeats, registration and adoption
+       probes all cross host boundaries, and the OS default for a
+       connect/read is minutes-to-forever. A single timeout-less call
+       re-introduces exactly the unbounded wait JL013 banned for
+       futures/queues — a partitioned peer then parks a worker past
+       every lease, breaker, and hedge budget. The socket-module
+       default (socket.setdefaulttimeout) is process-global state and
+       does NOT count: the bound must be visible at the call site.
+       Tree baseline: zero.
 """
 
 import ast
@@ -2416,6 +2431,85 @@ def rule_jl023(mod: ModuleInfo) -> Iterator[Finding]:
         )
 
 
+# ---------------------------------------------------------------------------
+# JL024 — wire calls without an explicit timeout in serving code
+# ---------------------------------------------------------------------------
+
+# client constructs whose OS-default wait is unbounded (or minutes), and
+# the positional index at which their signature accepts the timeout —
+# a call is bounded iff it passes timeout= (or fills that slot)
+_WIRE_TIMEOUT_SLOT = {
+    "HTTPConnection": 2,        # (host, port, timeout=...)
+    "HTTPSConnection": 2,
+    "urlopen": 2,               # (url, data, timeout=...)
+    "create_connection": 1,     # (address, timeout=...)
+}
+_REQUESTS_VERBS = {
+    "get", "post", "put", "delete", "head", "patch", "options", "request",
+}
+
+
+def rule_jl024(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL024: an HTTP/socket client call with no explicit ``timeout``
+    under ``speakingstyle_tpu/serving/`` — ``HTTPConnection``/
+    ``HTTPSConnection``, ``urlopen``, ``requests.<verb>``, or
+    ``socket.create_connection`` relying on OS defaults.
+
+    The cluster tier made the serving tree a wire client: dispatches,
+    heartbeats, registration, and adoption probes all cross a host
+    boundary, and a TCP connect/read with no timeout blocks for however
+    long the kernel feels like (minutes on an unroutable peer, forever
+    on a silent one). Every lease, breaker, and hedge budget in the
+    control plane assumes wire attempts FAIL in bounded time — one
+    timeout-less call re-opens the unbounded-wait hole JL013 closed for
+    futures and queues. ``socket.setdefaulttimeout`` does not satisfy
+    the rule: it is process-global, invisible at the call site, and one
+    import can silently reset it.
+    """
+    p = mod.path.replace("\\", "/")
+    if "speakingstyle_tpu/serving/" not in p:
+        return
+    for node in mod.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func) or ""
+        leaf = dotted.rsplit(".", 1)[-1]
+        slot = None
+        if leaf in _WIRE_TIMEOUT_SLOT:
+            # create_connection only as socket's (a local helper named
+            # create_connection is not a wire primitive)
+            if leaf == "create_connection" and not dotted.startswith(
+                    ("socket.", "create_connection")):
+                continue
+            slot = _WIRE_TIMEOUT_SLOT[leaf]
+        elif dotted.startswith("requests.") and leaf in _REQUESTS_VERBS:
+            slot = None   # requests' timeout is keyword-only in practice
+        else:
+            continue
+        if any(kw.arg == "timeout" for kw in node.keywords):
+            continue
+        if slot is not None and len(node.args) > slot:
+            continue   # the timeout slot is filled positionally
+        fn = mod.enclosing_function(node)
+        qual = mod.qualname(fn or mod.tree)
+        yield Finding(
+            rule="JL024",
+            path=mod.path,
+            line=node.lineno,
+            context=qual,
+            detail=f"{dotted}(...) with no explicit timeout",
+            message=(
+                f"`{dotted}(...)` in serving code ({qual}) has no "
+                "explicit timeout: a partitioned or silent peer then "
+                "blocks this thread past every lease/breaker/hedge "
+                "budget (the OS default is minutes to forever). Pass "
+                "timeout= at the call site — derive it from the "
+                "request class's deadline budget for dispatches, or "
+                "cluster.connect_timeout_s for control-plane calls."
+            ),
+        )
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -2440,4 +2534,5 @@ RULES = {
     "JL021": rule_jl021,
     "JL022": rule_jl022,
     "JL023": rule_jl023,
+    "JL024": rule_jl024,
 }

@@ -85,6 +85,14 @@ def build_parser(parser=None):
              "only)",
     )
     parser.add_argument(
+        "--cluster", action="store_true",
+        help="serve through the distributed control plane (overrides "
+             "serve.cluster.enabled): each replica is a separate PROCESS "
+             "spawned via `speakingstyle-tpu replica`, registered over "
+             "HTTP with heartbeat leases, dispatched with hedged retries "
+             "(fleet mode only — needs --replicas > 1)",
+    )
+    parser.add_argument(
         "--enable_rollout", action="store_true",
         help="enable POST /admin/rollout (canary-gated rolling model "
              "upgrade; fleet mode only — overrides serve.rollout.enabled)",
@@ -240,11 +248,57 @@ def main(args):
                 fault_plan=fault_plan,
             )
 
-        router = FleetRouter(
-            factory, cfg, replicas=replicas,
-            registry=registry, events=events, style=style,
-            fault_plan=fault_plan,
-        )
+        cluster_mode = args.cluster or cfg.serve.cluster.enabled
+        if cluster_mode:
+            # distributed control plane: replicas are separate processes
+            # spawned as `speakingstyle-tpu replica`, each restoring the
+            # same checkpoint and precompiling its own lattice.  The
+            # parent keeps the checkpoint load above only for the model
+            # identity + the shared style service (style vectors resolve
+            # router-side and ship over the wire as gamma/beta)
+            import subprocess
+            import sys
+
+            from speakingstyle_tpu.serving.cluster import ClusterRouter
+
+            def spawn(replica_id, router_addr, extra):
+                cmd = [
+                    sys.executable, "-m", "speakingstyle_tpu", "replica",
+                    "--replica_id", replica_id, "--router", router_addr,
+                    "--restore_step",
+                    str((extra or {}).get("restore_step",
+                                          args.restore_step)),
+                ]
+                if args.preset:
+                    cmd += ["--preset", args.preset]
+                for flag, val in (("-p", args.preprocess_config),
+                                  ("-m", args.model_config),
+                                  ("-t", args.train_config)):
+                    if val:
+                        cmd += [flag, val]
+                if args.vocoder_ckpt:
+                    cmd += ["--vocoder_ckpt", args.vocoder_ckpt]
+                if args.griffin_lim:
+                    cmd += ["--griffin_lim"]
+                return subprocess.Popen(cmd)
+
+            router = ClusterRouter(
+                spawn, cfg, replicas=replicas,
+                registry=registry, events=events, style=style,
+                fault_plan=fault_plan,
+            )
+            print(
+                f"cluster control plane on "
+                f"http://{router.control_addr} (lease ttl "
+                f"{cfg.serve.cluster.lease_ttl_s:g}s, quorum "
+                f"{cfg.serve.cluster.quorum})", flush=True,
+            )
+        else:
+            router = FleetRouter(
+                factory, cfg, replicas=replicas,
+                registry=registry, events=events, style=style,
+                fault_plan=fault_plan,
+            )
         router.set_model_version(
             model_version_string(info), info.get("step"),
             info.get("weights_digest"),
@@ -277,6 +331,14 @@ def main(args):
                     griffin_lim=args.griffin_lim, strict=True,
                     fault_plan=fault_plan, events=events, registry=registry,
                 )
+                if cluster_mode:
+                    # canary = a remote replica process restoring the
+                    # candidate step; the strict load above stays the
+                    # verify gate (corrupt candidates abort here)
+                    return (
+                        router.remote_factory({"restore_step": step}),
+                        model_version_string(info2), info2,
+                    )
 
                 def factory2(reg):
                     return SynthesisEngine(
@@ -301,6 +363,9 @@ def main(args):
     else:
         if args.enable_rollout:
             print("warning: --enable_rollout needs fleet mode "
+                  "(--replicas > 1); ignoring", flush=True)
+        if args.cluster:
+            print("warning: --cluster needs fleet mode "
                   "(--replicas > 1); ignoring", flush=True)
         from speakingstyle_tpu.serving.engine import SynthesisEngine
 

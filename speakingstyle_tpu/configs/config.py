@@ -991,6 +991,108 @@ class LongformConfig:
 
 
 @dataclass(frozen=True)
+class ClusterConfig:
+    """Distributed control plane knobs (serving/cluster.py —
+    ARCHITECTURE.md "Distributed control plane").
+
+    Disabled by default: with ``enabled: false`` the fleet router keeps
+    its in-process replica engines and nothing here applies. Enabled,
+    every replica is a separate *process* (cli/replica.py) that owns a
+    full AOT engine and registers with the router over HTTP; liveness is
+    heartbeat leases, dispatch is hedged with per-class timeouts, and
+    the autoscaler's scale_to() spawns/drains real processes.
+    """
+
+    enabled: bool = False
+    # control-plane bind address for the router's /register + /heartbeat
+    # endpoints (port 0 = ephemeral, the bound port is advertised to
+    # spawned replicas via --router)
+    control_host: str = "127.0.0.1"
+    control_port: int = 0
+    # replica -> router heartbeat cadence; a lease is granted for
+    # heartbeat_interval_s * (lease_miss_budget + 1) and renewed on every
+    # beat, so a replica may miss `lease_miss_budget` consecutive beats
+    # before the lease expires and the router fails it
+    heartbeat_interval_s: float = 0.5
+    lease_miss_budget: int = 3
+    # hedged dispatch: a second request goes to a different host once the
+    # first has been outstanding longer than this quantile of the class's
+    # observed wire latency (serve_wire_latency_seconds), clamped into
+    # [hedge_min_ms, hedge_max_ms]; first response wins, the loser's
+    # connection is torn down. 0 quantile disables hedging.
+    hedge_quantile: float = 0.95
+    hedge_min_ms: float = 50.0
+    hedge_max_ms: float = 2000.0
+    # TCP connect timeout for every control + dispatch connection; the
+    # per-attempt read timeout derives from the request's class deadline
+    # (never unbounded — jaxlint JL024 enforces this structurally)
+    connect_timeout_s: float = 2.0
+    # a spawned replica process must register within this budget or the
+    # spawn is declared failed (covers engine AOT warmup; the measured
+    # serve_replica_warmup_seconds histogram still feeds the autoscaler)
+    spawn_grace_s: float = 120.0
+    # /healthz readiness quorum: the server answers 503 until at least
+    # this many replicas hold live leases and are READY
+    quorum: int = 1
+    # bounded per-replica idempotency cache (keys of executed dispatch
+    # batches -> cached wire response), so a hedge or wire retry of an
+    # already-executed batch never re-runs the lattice
+    idempotency_cache: int = 256
+
+    def __post_init__(self):
+        if self.heartbeat_interval_s <= 0:
+            raise ValueError(
+                f"serve.cluster.heartbeat_interval_s must be > 0, "
+                f"got {self.heartbeat_interval_s}"
+            )
+        if self.lease_miss_budget < 1:
+            raise ValueError(
+                f"serve.cluster.lease_miss_budget must be >= 1, "
+                f"got {self.lease_miss_budget}"
+            )
+        if not (0.0 <= self.hedge_quantile < 1.0):
+            raise ValueError(
+                f"serve.cluster.hedge_quantile must be in [0, 1) "
+                f"(0 disables hedging), got {self.hedge_quantile}"
+            )
+        if self.hedge_min_ms < 0:
+            raise ValueError(
+                f"serve.cluster.hedge_min_ms must be >= 0, "
+                f"got {self.hedge_min_ms}"
+            )
+        if self.hedge_max_ms < self.hedge_min_ms:
+            raise ValueError(
+                "serve.cluster.hedge_max_ms must be >= hedge_min_ms, got "
+                f"{self.hedge_max_ms} < {self.hedge_min_ms}"
+            )
+        if self.connect_timeout_s <= 0:
+            raise ValueError(
+                f"serve.cluster.connect_timeout_s must be > 0, "
+                f"got {self.connect_timeout_s}"
+            )
+        if self.spawn_grace_s <= 0:
+            raise ValueError(
+                f"serve.cluster.spawn_grace_s must be > 0, "
+                f"got {self.spawn_grace_s}"
+            )
+        if self.quorum < 1:
+            raise ValueError(
+                f"serve.cluster.quorum must be >= 1, got {self.quorum}"
+            )
+        if self.idempotency_cache < 1:
+            raise ValueError(
+                f"serve.cluster.idempotency_cache must be >= 1, "
+                f"got {self.idempotency_cache}"
+            )
+
+    @property
+    def lease_ttl_s(self) -> float:
+        """Lease duration granted per heartbeat: the replica may miss
+        ``lease_miss_budget`` consecutive beats before expiry."""
+        return self.heartbeat_interval_s * (self.lease_miss_budget + 1)
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Continuous-batching synthesis server knobs (serving/engine.py,
     serving/batcher.py).
@@ -1044,6 +1146,9 @@ class ServeConfig:
     frontend_workers: int = 2
     # fleet serving: multi-replica router, SLO admission, streaming
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    # distributed control plane: replica processes with heartbeat leases
+    # and hedged dispatch (disabled by default — in-process replicas)
+    cluster: ClusterConfig = field(default_factory=ClusterConfig)
     # closed-loop autoscaler over the fleet (disabled by default)
     autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
     # style service: AOT reference-encoder lattice + embedding cache

@@ -43,6 +43,16 @@ Counter semantics per kind:
                             1-based) raises InjectedFault before device
                             work — drives the tier-b→tier-a
                             (ring→chunked) degradation drill
+  ``replica_proc_kill@N``   the fleet router's Nth coalesced dispatch
+                            (the replica_raise counter) SIGKILLs the
+                            target replica's *process* before the wire
+                            call — the cluster tier's hard-death drill
+                            (in-process routers treat it as a raise)
+  ``net_partition@N``       same counter; the router↔replica link for
+                            the target replica drops every packet from
+                            here on (dispatches fail fast, heartbeats
+                            stop renewing the lease) until the drill
+                            heals it — the partition-grade chaos drill
 
   checkpoint (training/checkpoint.py; the lifecycle drills):
 
@@ -73,7 +83,7 @@ ENV_VAR = "SPEAKINGSTYLE_FAULTS"
 TRAINING_KINDS = ("loader_ioerror", "nan_grads", "sigterm")
 SERVING_KINDS = (
     "replica_raise", "replica_hang", "style_encode_error", "vocoder_raise",
-    "longform_ring_error",
+    "longform_ring_error", "replica_proc_kill", "net_partition",
 )
 CHECKPOINT_KINDS = ("checkpoint_corrupt", "manifest_missing")
 KINDS = TRAINING_KINDS + SERVING_KINDS + CHECKPOINT_KINDS

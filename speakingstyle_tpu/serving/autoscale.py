@@ -129,10 +129,16 @@ class Autoscaler:
         # scale-ups stay allowed — an upgrade under pressure still grows
         rolling = bool(getattr(self.router, "rollout_active", False))
 
+        # the floor is min_replicas OR the router's own (a ClusterRouter
+        # publishes its readiness quorum as scale_floor — draining below
+        # it would wedge /healthz at 503 with the fleet nominally calm)
+        floor = max(a.min_replicas, int(getattr(self.router,
+                                                "scale_floor", 0)))
+
         # bound enforcement outranks hysteresis: an out-of-bounds fleet
         # (operator scale_to, config change) is corrected immediately
-        if live < a.min_replicas:
-            return self._decide("up", "min_bound", a.min_replicas, now,
+        if live < floor:
+            return self._decide("up", "min_bound", floor, now,
                                 depth=depth, live=live, occupancy=occ,
                                 pressure_rate=rate)
         if live > a.max_replicas:
@@ -181,7 +187,7 @@ class Autoscaler:
             return None
         if self._calm_since is None:
             self._calm_since = now
-        if live <= a.min_replicas:
+        if live <= floor:
             return None
         # the calm window scales with what the capacity COST to build:
         # a replica that took 30 s to warm is not shed after 5 quiet

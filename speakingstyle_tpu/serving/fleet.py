@@ -773,6 +773,16 @@ class FleetRouter:
                     time.sleep(
                         3.0 * self._watchdog if self._watchdog > 0 else 0.5
                     )
+                if self.fault_plan.fire("replica_proc_kill", n):
+                    if not self._chaos_proc_kill(rep):
+                        raise InjectedFault(
+                            f"injected replica_proc_kill at dispatch {n}"
+                        )
+                if self.fault_plan.fire("net_partition", n):
+                    if not self._chaos_partition(rep):
+                        raise InjectedFault(
+                            f"injected net_partition at dispatch {n}"
+                        )
             # jaxlint: disable=JL020 reason=engine set under _cond before this generation's worker starts and never reassigned within a generation
             results = rep.engine.run([p.request for p in batch])
         except BaseException as e:
@@ -863,6 +873,23 @@ class FleetRouter:
                 if not p.future.done():
                     p.future.set_exception(err)
         return True
+
+    def _chaos_proc_kill(self, rep: Replica) -> bool:
+        """Hook for the ``replica_proc_kill`` drill.  The base router's
+        replicas are in-process (there is no process to kill), so this
+        returns False and the dispatch raises InjectedFault instead —
+        the same failure path, one level down.  ClusterRouter overrides
+        it to SIGKILL the replica's real process and returns True: the
+        wire call that follows then fails organically."""
+        return False
+
+    def _chaos_partition(self, rep: Replica) -> bool:
+        """Hook for the ``net_partition`` drill.  Base router: False
+        (no wire to cut) -> InjectedFault.  ClusterRouter overrides it
+        to drop all router<->replica packets for this replica until the
+        drill heals the link; the dispatch and every heartbeat then fail
+        organically."""
+        return False
 
     def _replica_failed(self, rep: Replica, batch: List[_Pending],
                         error: BaseException, kind: str) -> None:

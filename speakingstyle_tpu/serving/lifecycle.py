@@ -41,6 +41,14 @@ concurrent ``POST /admin/rollout`` gets ``RolloutInProgress`` (HTTP
 409). Everything here drives public FleetRouter surface — the manager
 owns no replica state of its own, so a crashed rollout leaves a fleet
 that the supervisor already knows how to heal.
+
+Cluster mode changes none of this: with a ``ClusterRouter`` the
+candidate factory is ``router.remote_factory({"restore_step": N})``, so
+the canary is a separate replica *process* restoring the candidate step
+— but it still warms through ``start_replica``, still replays the
+golden set (wire results carry the mel, so the |Δmel| gate is
+unchanged), and the drain-replace loop drives the same RemoteReplica
+surface as every other replica.
 """
 
 import threading

@@ -71,6 +71,27 @@ class DispatchError(RuntimeError):
     this and stays alive.  Maps to HTTP 500."""
 
 
+class LeaseExpired(RuntimeError):
+    """A remote replica missed its heartbeat lease miss budget (process
+    death, partition, or a wedged host).  The cluster router's lease
+    sweeper raises this into the standard ``_replica_failed`` path, so an
+    expired lease is indistinguishable from an in-process replica raise:
+    breaker opens, in-flight work requeues at its original deadline."""
+
+    def __init__(self, message: str, replica_id: str = "", age_s: float = 0.0):
+        super().__init__(message)
+        self.replica_id = replica_id
+        self.age_s = age_s
+
+
+class WireError(RuntimeError):
+    """A dispatch attempt over the wire failed terminally for this
+    request (connect/read timeout after the class's retry budget, a
+    partitioned host, or a malformed response).  Transient to the
+    supervision machinery — the router requeues the batch exactly like
+    an in-process replica raise."""
+
+
 class CircuitBreaker:
     """Per-replica breaker: closed -> open (on failure, with exponential
     backoff) -> half-open (re-warm trial) -> closed (first success).

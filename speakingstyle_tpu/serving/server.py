@@ -774,9 +774,15 @@ class SynthesisServer:
                 version = outer.model_version()
                 if version is not None:
                     extra_hdr["X-Model-Version"] = version
+                # cluster mode: which replica process actually served
+                # this — joins the req_id trail in the JSONL events
+                served_by = getattr(result, "served_by", None)
+                if served_by:
+                    extra_hdr["X-Served-By"] = served_by
                 if result.wav is None:
                     # vocoder-less engine: return the mel as JSON
-                    outer._request_done(req_id, parsed.path, 200, t0)
+                    outer._request_done(req_id, parsed.path, 200, t0,
+                                        served_by=served_by)
                     return self._json(200, {
                         "id": result.id,
                         "mel_len": result.mel_len,
@@ -784,7 +790,8 @@ class SynthesisServer:
                     }, req_id=req_id, headers=extra_hdr or None)
                 sr = outer.cfg.preprocess.preprocessing.audio.sampling_rate
                 body = wav_bytes(result.wav, sr)
-                outer._request_done(req_id, parsed.path, 200, t0)
+                outer._request_done(req_id, parsed.path, 200, t0,
+                                    served_by=served_by)
                 self.send_response(200)
                 self.send_header("Content-Type", "audio/wav")
                 self.send_header("Content-Length", str(len(body)))
@@ -794,6 +801,8 @@ class SynthesisServer:
                     self.send_header("X-Style-Degraded", "1")
                 if version is not None:
                     self.send_header("X-Model-Version", version)
+                if served_by:
+                    self.send_header("X-Served-By", served_by)
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -1103,7 +1112,8 @@ class SynthesisServer:
         return out
 
     def _request_done(
-        self, req_id: str, path: str, status: int, t0: float
+        self, req_id: str, path: str, status: int, t0: float,
+        served_by: Optional[str] = None,
     ) -> None:
         dur = time.monotonic() - t0
         if status >= 400:
@@ -1114,10 +1124,14 @@ class SynthesisServer:
             help="HTTP handler wall time (parse + G2P + batcher wait)",
         ).observe(dur)
         if self.events is not None:
-            self.events.emit(
-                "http_request", req_id=req_id, path=path, status=status,
-                duration_s=dur,
-            )
+            fields = dict(req_id=req_id, path=path, status=status,
+                          duration_s=dur)
+            if served_by:
+                # cluster mode: the replica process host joins the
+                # req_id trail, so one grep follows a request from
+                # admission to the host that served it
+                fields["served_by"] = served_by
+            self.events.emit("http_request", **fields)
 
     def model_info(self) -> Optional[Dict]:
         """{version, step, weights_digest} for the serving model, or
@@ -1206,6 +1220,16 @@ class SynthesisServer:
             out["replicas"] = {
                 str(i): s for i, s in sorted(self.router.states().items())
             }
+            # cluster mode: the remote control plane's view — one row
+            # per lease (host, age, last heartbeat, partition flag).
+            # ready() above is already quorum-gated, so /healthz answers
+            # 503 until at least cluster.quorum replicas hold leases
+            if hasattr(self.router, "cluster_stats"):
+                out["cluster"] = {
+                    "quorum": self.router.ccfg.quorum,
+                    "control_addr": self.router.control_addr,
+                    "replicas": self.router.cluster_stats(),
+                }
         # which WEIGHTS is this process serving: version string +
         # checkpoint step + digest (fleet mode tracks rollouts live via
         # router.set_model_version; single-engine mode is pinned at

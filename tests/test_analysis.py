@@ -1227,6 +1227,87 @@ def test_jl019_tree_baseline_is_zero():
 
 
 # ---------------------------------------------------------------------------
+# JL024 — wire calls without an explicit timeout in serving code
+# ---------------------------------------------------------------------------
+
+
+def test_jl024_positive_each_wire_primitive():
+    src = """
+        import socket
+        import urllib.request
+        from http.client import HTTPConnection
+        import requests
+
+        def register(host, port, url):
+            conn = HTTPConnection(host, port)
+            page = urllib.request.urlopen(url)
+            resp = requests.post(url, json={"ready": True})
+            raw = socket.create_connection((host, port))
+            return conn, page, resp, raw
+    """
+    found = [
+        f for f in linter.lint_source(textwrap.dedent(src), _SERVING_PATH)
+        if f.rule == "JL024"
+    ]
+    assert len(found) == 4
+    assert {f.detail.split("(")[0] for f in found} == {
+        "HTTPConnection", "urllib.request.urlopen", "requests.post",
+        "socket.create_connection",
+    }
+
+
+def test_jl024_negative_bounded_calls():
+    # the sanctioned shapes: timeout= keyword anywhere, or the
+    # positional timeout slot filled (HTTPConnection's third arg,
+    # urlopen's third, create_connection's second)
+    assert "JL024" not in _codes("""
+        import socket
+        import urllib.request
+        from http.client import HTTPConnection
+        import requests
+
+        def register(host, port, url, budget_s):
+            conn = HTTPConnection(host, port, timeout=budget_s)
+            pos = HTTPConnection(host, port, budget_s)
+            page = urllib.request.urlopen(url, None, budget_s)
+            resp = requests.post(url, json={}, timeout=budget_s)
+            raw = socket.create_connection((host, port), budget_s)
+            return conn, pos, page, resp, raw
+    """, path=_SERVING_PATH)
+
+
+def test_jl024_negative_scope_and_lookalikes():
+    # non-serving code may rely on defaults (offline tooling), and a
+    # LOCAL helper that happens to be named create_connection is not
+    # the socket primitive
+    src = """
+        from http.client import HTTPConnection
+
+        def fetch(host, port):
+            return HTTPConnection(host, port)
+    """
+    assert "JL024" not in _codes(
+        src, path="speakingstyle_tpu/training/fake.py"
+    )
+    assert "JL024" not in _codes("""
+        def probe(pool, addr):
+            return pool.create_connection(addr)
+    """, path=_SERVING_PATH)
+
+
+def test_jl024_tree_baseline_is_zero():
+    """The control plane's bounded-wire claim, structurally: every
+    dispatch, heartbeat, registration, and adoption probe in serving/
+    passes an explicit timeout (lease/breaker/hedge budgets assume wire
+    attempts fail in bounded time)."""
+    findings = [f for f in linter.lint_paths() if f.rule == "JL024"]
+    assert findings == [], (
+        "JL024 must stay at zero tree findings — pass timeout= at every "
+        f"serving wire call: {[f.fingerprint for f in findings]}"
+    )
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -1365,6 +1446,11 @@ def test_every_rule_is_non_vacuous():
     # was written streaming-first (Stitcher seams, window yields), and
     # test_jl019_tree_baseline_is_zero pins the accumulate-then-concat
     # count at zero.
+    # JL024 is absent by construction too: the cluster tier that made
+    # serving/ a wire client shipped with an explicit timeout on every
+    # HTTP/socket call (derived from deadline budgets or
+    # connect_timeout_s), and test_jl024_tree_baseline_is_zero pins the
+    # unbounded-wire count at zero.
     for code in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
                  "JL007", "JL008"):
         assert code in fired, f"{code} never fires on the real tree"
@@ -1413,13 +1499,16 @@ def test_cli_check_exits_zero_on_repo():
     ("JL019", "import numpy as np\n\ndef collect(chunks):\n    out = []\n"
               "    for c in chunks:\n        out.append(c)\n"
               "    return np.concatenate(out)\n"),
+    ("JL024", "from http.client import HTTPConnection\n\ndef ping(host):\n"
+              "    return HTTPConnection(host, 80)\n"),
 ])
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
     # JL004 is scoped to training/ paths; JL007 to speakingstyle_tpu/;
-    # JL011-JL013, JL015, JL016 and JL019 to speakingstyle_tpu/serving/;
-    # JL017 to both training/ and serving/ (training default suffices)
+    # JL011-JL013, JL015, JL016, JL019 and JL024 to
+    # speakingstyle_tpu/serving/; JL017 to both training/ and serving/
+    # (training default suffices)
     sub = ("serving" if code in ("JL011", "JL012", "JL013", "JL015", "JL016",
-                                 "JL019")
+                                 "JL019", "JL024")
            else "training")
     d = tmp_path / "speakingstyle_tpu" / sub
     d.mkdir(parents=True)

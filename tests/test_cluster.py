@@ -1,0 +1,563 @@
+"""Distributed control plane (tier-1).
+
+Four layers, mirroring serving/cluster.py:
+  1. lease table — epoch fencing and strict expiry against an explicit
+     clock (no threads, no sockets, microsecond-fast);
+  2. wire codec + idempotency — request/result round-trips and the
+     replica-side duplicate-dispatch cache (no router);
+  3. cluster e2e against in-process replica "processes" (a FakeProc
+     wraps a real ReplicaServer + toy engine, so registration,
+     heartbeats, dispatch, and chaos all cross real HTTP) — lease
+     expiry mid-dispatch requeues without duplicating, partition heal
+     re-admits through the breaker's half-open, a chaos process kill
+     loses zero requests, and a slow primary is hedged to a second
+     host;
+  4. the surfaces other subsystems consume — quorum-gated readiness in
+     /healthz stats and the autoscaler's scale floor.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from speakingstyle_tpu.configs.config import (
+    AutoscaleConfig,
+    ClusterConfig,
+    Config,
+    FleetConfig,
+    ServeConfig,
+)
+from speakingstyle_tpu.faults import FaultPlan
+from speakingstyle_tpu.obs import MetricsRegistry
+from speakingstyle_tpu.serving.cluster import (
+    ClusterRouter,
+    LeaseTable,
+    ReplicaServer,
+    batch_key,
+    decode_request,
+    decode_result,
+    encode_request,
+    encode_result,
+    _post_json,
+)
+from speakingstyle_tpu.serving.engine import SynthesisRequest
+from speakingstyle_tpu.serving.fleet import FAILED, READY
+
+# ---------------------------------------------------------------------------
+# lease table (explicit clock, no threads)
+# ---------------------------------------------------------------------------
+
+
+def test_lease_heartbeat_exactly_at_expiry_renews():
+    """Expiry is strict: a beat landing exactly ON the deadline still
+    renews (now <= deadline); one tick past it does not."""
+    t = LeaseTable(ttl_s=1.0)
+    ok, epoch = t.register("r1", "127.0.0.1", 9999, 1, 42, now=100.0)
+    assert ok and epoch == 1
+    # exactly at the deadline: renewed, and the deadline slides forward
+    assert t.heartbeat("r1", 1, True, now=101.0) == "renewed"
+    lease = t.get("r1")
+    assert lease.deadline == 102.0 and lease.ready
+    # one tick past the (renewed) deadline: expired, lease untouched
+    assert t.heartbeat("r1", 1, True, now=102.0 + 1e-9) == "expired"
+    assert not t.alive("r1", now=102.0 + 1e-9)
+    assert t.alive("r1", now=102.0)   # boundary is inclusive here too
+
+
+def test_lease_epoch_fencing():
+    """A registration or beat carrying an epoch older than the table's
+    is rejected with the current epoch, so the caller can jump past it;
+    an unknown replica's beat tells it to re-register."""
+    t = LeaseTable(ttl_s=1.0)
+    assert t.register("r1", "h", 1, 3, 0, now=0.0) == (True, 3)
+    # stale re-register: rejected, answer carries the fencing epoch
+    assert t.register("r1", "h", 1, 2, 0, now=0.5) == (False, 3)
+    # stale beat from the zombie incarnation: fenced out
+    assert t.heartbeat("r1", 2, True, now=0.5) == "stale"
+    # the newer incarnation re-registers above the fence and lives on
+    assert t.register("r1", "h", 1, 4, 0, now=0.5) == (True, 4)
+    assert t.heartbeat("r1", 4, True, now=0.9) == "renewed"
+    assert t.heartbeat("ghost", 1, True, now=0.9) == "unknown"
+    t.drop("r1")
+    assert t.heartbeat("r1", 4, True, now=1.0) == "unknown"
+
+
+# ---------------------------------------------------------------------------
+# wire codec + idempotency
+# ---------------------------------------------------------------------------
+
+
+def _req(i, L=8, T=4, **kw):
+    return SynthesisRequest(
+        id=f"q{i}", sequence=np.arange(1, L + 1, dtype=np.int32),
+        ref_mel=np.random.default_rng(i).standard_normal(
+            (T, 80)).astype(np.float32),
+        **kw,
+    )
+
+
+def test_wire_codec_request_roundtrip():
+    r = _req(0, p_control=1.25,
+             d_control=np.linspace(0.5, 2.0, 8).astype(np.float32))
+    d = encode_request(r)
+    assert "arrival" not in d   # monotonic stamps do not transfer
+    back = decode_request(d)
+    assert back.id == r.id
+    np.testing.assert_array_equal(back.sequence, r.sequence)
+    np.testing.assert_array_equal(back.ref_mel, r.ref_mel)
+    assert back.p_control == 1.25
+    np.testing.assert_array_equal(back.d_control, r.d_control)
+    # decoded arrays must be writable (pool staging slice-assigns)
+    back.ref_mel[0, 0] = 7.0
+
+
+def test_wire_codec_result_roundtrip_duck_typed():
+    mel = np.random.default_rng(1).standard_normal((6, 80)).astype(
+        np.float32)
+    full = SimpleNamespace(id="a", mel=mel, mel_len=6, src_len=3,
+                           bucket=SimpleNamespace(b=1, l_src=8, t_mel=16))
+    sparse = SimpleNamespace(id="b")   # toy engines return bare objects
+    out_full = decode_result(encode_result(full), served_by="h:1")
+    out_sparse = decode_result(encode_result(sparse))
+    np.testing.assert_array_equal(out_full.mel, mel)
+    assert out_full.mel_len == 6 and out_full.served_by == "h:1"
+    assert (out_full.bucket.b, out_full.bucket.l_src,
+            out_full.bucket.t_mel) == (1, 8, 16)
+    assert out_sparse.id == "b" and out_sparse.bucket is None
+    assert out_sparse.mel.size == 0 and out_sparse.wav is None
+
+
+def test_batch_key_stable_and_membership_sensitive():
+    a = [_req(1), _req(2)]
+    assert batch_key(a) == batch_key(list(a))
+    assert batch_key(a) != batch_key([_req(1)])       # different membership
+    assert batch_key(a) != batch_key([_req(2), _req(1)])  # different order
+    assert len(batch_key(a)) == 32
+
+
+class _CountingEngine:
+    is_ready = True
+
+    def __init__(self, stall_s=0.0, stall_ids=()):
+        self.runs = []
+        self.stall_s = stall_s
+        self.stall_ids = set(stall_ids)
+        self.unstall = threading.Event()
+        self._lock = threading.Lock()
+
+    def precompile(self):
+        return 0.0
+
+    def run(self, requests):
+        if any(r.id in self.stall_ids for r in requests):
+            self.unstall.wait(timeout=self.stall_s)
+        with self._lock:
+            self.runs.extend(r.id for r in requests)
+        return [SimpleNamespace(id=r.id, mel_len=1) for r in requests]
+
+
+def test_idempotency_cache_dedupes_and_evicts():
+    """Check-then-run-then-store is atomic: the duplicate leg of a
+    hedge is a cache lookup, never a second lattice run — and the cache
+    is bounded (LRU) so it can never grow with traffic (JL012)."""
+    eng = _CountingEngine()
+    srv = ReplicaServer(eng, "r1", "127.0.0.1:9", ClusterConfig(
+        idempotency_cache=2))
+    try:
+        body = {"key": "k1", "requests": [encode_request(_req(1))]}
+        code, first = srv._handle_dispatch(body)
+        assert code == 200 and first["idempotent"] is False
+        code, dup = srv._handle_dispatch(body)
+        assert code == 200 and dup["idempotent"] is True
+        assert dup["results"][0]["id"] == "q1"
+        assert eng.runs == ["q1"]   # exactly one real run
+        assert srv._idem_hits.value == 1
+        # two more distinct keys evict k1 from the 2-entry cache
+        for k, i in (("k2", 2), ("k3", 3)):
+            srv._handle_dispatch(
+                {"key": k, "requests": [encode_request(_req(i))]})
+        assert srv._idem_evict.value == 1
+        code, rerun = srv._handle_dispatch(body)
+        assert rerun["idempotent"] is False   # evicted: genuinely re-ran
+        assert eng.runs.count("q1") == 2
+    finally:
+        srv._httpd.server_close()
+
+
+def test_idempotency_duplicate_leg_parks_during_execution():
+    """A hedge leg arriving WHILE the first leg is still running its
+    batch must park on the in-flight claim and answer from the cache —
+    never a second lattice run, and never while holding the dispatch
+    lock across engine.run (the witness-visible lock-order hazard the
+    in-flight protocol exists to avoid)."""
+    eng = _CountingEngine(stall_s=5.0, stall_ids=("q1",))
+    srv = ReplicaServer(eng, "r1", "127.0.0.1:9", ClusterConfig())
+    try:
+        body = {"key": "k1", "requests": [encode_request(_req(1))]}
+        out = {}
+
+        def first_leg():
+            out["first"] = srv._handle_dispatch(body)
+
+        t = threading.Thread(target=first_leg, daemon=True)
+        t.start()
+        assert _wait(lambda: "k1" in srv._inflight, 2.0)
+        # duplicate leg fires mid-execution, then the stall releases
+        def second_leg():
+            out["dup"] = srv._handle_dispatch(body)
+
+        t2 = threading.Thread(target=second_leg, daemon=True)
+        t2.start()
+        time.sleep(0.05)
+        eng.unstall.set()
+        t.join(timeout=5)
+        t2.join(timeout=5)
+        assert out["first"][1]["idempotent"] is False
+        assert out["dup"][1]["idempotent"] is True
+        assert eng.runs == ["q1"]   # exactly one real run
+        assert srv._inflight == {}  # claim cleared
+    finally:
+        srv._httpd.server_close()
+
+
+# ---------------------------------------------------------------------------
+# cluster e2e — in-process replica "processes" over real HTTP
+# ---------------------------------------------------------------------------
+
+
+class _FakeProc:
+    """One replica process, in-process: a real ReplicaServer (its own
+    HTTP socket, registration, heartbeat thread) behind the subprocess
+    surface ``_acquire_replica``/``_retire_process`` drive."""
+
+    def __init__(self, rid, router_addr, ccfg, engine=None):
+        self.engine = engine if engine is not None else _CountingEngine()
+        self.server = ReplicaServer(self.engine, rid, router_addr, ccfg)
+        self._rc = None
+        self.server.start()
+
+    def poll(self):
+        return self._rc
+
+    def terminate(self):
+        self._rc = 0
+        self.engine.unstall.set()
+        self.server.close()
+
+    kill = terminate
+
+    def wait(self, timeout=None):
+        return self._rc
+
+
+def _cfg(**cluster_kw):
+    ckw = dict(enabled=True, heartbeat_interval_s=0.1, lease_miss_budget=3,
+               spawn_grace_s=10.0, quorum=1, hedge_quantile=0.0)
+    ckw.update(cluster_kw)
+    return Config(serve=ServeConfig(
+        batch_buckets=[1], src_buckets=[16], mel_buckets=[64],
+        frames_per_phoneme=2, max_wait_ms=5.0,
+        fleet=FleetConfig(
+            queue_depth=64, stream_window=8,
+            rewarm_backoff_s=0.05, rewarm_backoff_max_s=0.5,
+            class_deadline_ms={"interactive": 10_000.0,
+                               "batch": 20_000.0},
+        ),
+        cluster=ClusterConfig(**ckw),
+    ))
+
+
+def _make_cluster(replicas, engine_factory=None, **cluster_kw):
+    cfg = _cfg(**cluster_kw)
+    procs = {}
+
+    def spawn(rid, router_addr, extra):
+        eng = engine_factory(rid) if engine_factory is not None else None
+        p = _FakeProc(rid, router_addr, cfg.serve.cluster, engine=eng)
+        procs[rid] = p
+        return p
+
+    reg = MetricsRegistry()
+    router = ClusterRouter(spawn, cfg, replicas=replicas, registry=reg,
+                           fault_plan=FaultPlan())
+    return router, procs, reg
+
+
+def _ready_count(router):
+    return sum(s == READY for s in router.states().values())
+
+
+def _wait(pred, timeout=20.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def test_cluster_dispatch_quorum_and_stale_register():
+    """Happy path: dispatches cross the wire with served_by stamped;
+    ready() is quorum-gated; a stale-epoch registration is 409ed with
+    the fencing epoch (the wire half of the epoch fence)."""
+    router, procs, reg = _make_cluster(replicas=1, quorum=2)
+    try:
+        assert router.wait_ready(timeout=20, n=1)
+        # one READY replica under quorum=2: NOT ready (healthz 503)
+        assert router.ready() is False
+        router.scale_to(2)
+        assert router.wait_ready(timeout=20, n=2)
+        assert router.ready() is True
+        futs = [router.submit(_req(i)) for i in range(4)]
+        served = {f.result(timeout=10).served_by for f in futs}
+        assert all(served)
+        rows = router.cluster_stats()
+        assert len(rows) == 2
+        for row in rows:
+            assert row["ready"] and not row["expired"]
+            assert "lease_age_s" in row and "last_heartbeat_s" in row
+        # stale epoch over the wire: 409 + the epoch to register above
+        host, _, port = router.control_addr.rpartition(":")
+        rid = rows[0]["replica_id"]
+        code, body = _post_json(host, int(port), "/register", {
+            "replica_id": rid, "host": "127.0.0.1", "port": 1,
+            "epoch": 0, "pid": 0,
+        }, timeout=2.0)
+        assert code == 409 and body["epoch"] >= 1
+    finally:
+        router.close()
+    assert all(p.poll() is not None for p in procs.values())
+
+
+def test_lease_expiry_mid_dispatch_requeues_not_duplicates():
+    """A lease expiring under an in-flight dispatch steals the batch
+    (hang-watchdog style) and requeues it at its original deadline; the
+    stalled replica's late result fails its claim and is discarded, so
+    the client sees exactly one result — from the OTHER replica."""
+    once = {"armed": True}
+    arm_lock = threading.Lock()
+
+    class _StallFirst(_CountingEngine):
+        # only the FIRST engine to see q100 stalls: the requeued batch
+        # must run clean on the survivor
+        def run(self, requests):
+            if any(r.id == "q100" for r in requests):
+                with arm_lock:
+                    hit = once["armed"]
+                    once["armed"] = False
+                if hit:
+                    self.unstall.wait(timeout=30.0)
+            return super().run(requests)
+
+    engines = {}
+
+    def factory(rid):
+        engines[rid] = _StallFirst()
+        return engines[rid]
+
+    router, procs, reg = _make_cluster(replicas=2, engine_factory=factory)
+    try:
+        assert router.wait_ready(timeout=20, n=2)
+        fut = router.submit(_req(100))
+        # find which replica holds q100 in flight, then partition it so
+        # its heartbeats stop renewing and the lease ages out (TTL =
+        # 0.1s * (3 + 1) = 0.4s)
+        assert _wait(lambda: any(r.inflight for r in router._replicas),
+                     timeout=5)
+        stalled = None
+        for rep in router._replicas:
+            if rep.inflight:
+                stalled = rep.engine.replica_id
+        assert stalled is not None
+        stalled_addr = f"{procs[stalled].server.host}:" \
+                       f"{procs[stalled].server.port}"
+        router.partition(stalled)
+        # the sweeper expires the lease and requeues; the survivor runs
+        # the batch and completes the future
+        result = fut.result(timeout=20)
+        assert result.served_by != stalled_addr
+        assert reg.value("serve_lease_expired_total") == 1
+        assert reg.histogram("serve_lease_requeue_seconds").count >= 1
+        # release the zombie leg: its late claim must be discarded, not
+        # doubled into the (already resolved) future
+        procs[stalled].engine.unstall.set()
+        time.sleep(0.3)
+        assert fut.result(timeout=1).served_by != stalled_addr
+        survivors = [e for r, e in engines.items() if r != stalled]
+        assert sum(e.runs.count("q100") for e in survivors) == 1
+    finally:
+        for p in procs.values():
+            p.engine.unstall.set()
+        router.close()
+
+
+def test_partition_heal_readmits_same_process_via_half_open():
+    """A partitioned replica fails (lease expiry -> breaker) and its
+    still-live process is stashed as an orphan; healing the partition
+    lets the next half-open re-warm ADOPT that process instead of
+    spawning — same pid, bumped epoch."""
+    router, procs, reg = _make_cluster(replicas=2, quorum=2)
+    try:
+        assert router.wait_ready(timeout=20, n=2)
+        target = router._replicas[0].engine.replica_id
+        epoch_before = router.leases.get(target).epoch
+        router.partition(target)
+        assert _wait(lambda: FAILED in router.states().values(),
+                     timeout=20)
+        assert router.ready() is False   # below quorum while failed
+        spawned_before = len(procs)
+        router.heal(target)
+        assert _wait(lambda: _ready_count(router) >= 2, timeout=20)
+        assert router.ready() is True
+        # adopted, not respawned: no new process, epoch moved past the
+        # partition-era lease
+        assert len(procs) == spawned_before
+        assert router.leases.get(target).epoch > epoch_before
+        futs = [router.submit(_req(200 + i)) for i in range(3)]
+        assert all(f.result(timeout=10).served_by for f in futs)
+    finally:
+        router.close()
+
+
+def test_chaos_proc_kill_loses_zero_requests():
+    """The replica_proc_kill chaos fault kills a real process
+    mid-dispatch; every submitted request still completes (requeue +
+    respawn), and the fleet returns to full READY strength."""
+    router, procs, reg = _make_cluster(replicas=2, quorum=2)
+    try:
+        assert router.wait_ready(timeout=20, n=2)
+        for f in [router.submit(_req(i)) for i in range(4)]:
+            f.result(timeout=10)
+        router.fault_plan.arm("replica_proc_kill",
+                              router.dispatch_total + 1)
+        futs = [router.submit(_req(100 + i)) for i in range(8)]
+        for f in futs:
+            assert f.result(timeout=30).served_by   # zero lost
+        assert sum(p.poll() is not None for p in procs.values()) == 1
+        assert _wait(lambda: _ready_count(router) >= 2, timeout=20)
+        assert len(procs) == 3   # the kill forced one real respawn
+    finally:
+        router.close()
+
+
+def test_hedge_fires_on_slow_primary_and_second_host_wins():
+    """A slow (not failed) first leg hedges to a different host after
+    the class's hedge delay; the hedge wins, the client result carries
+    the second host, and both hedge counters account for it."""
+    stall_once = {"armed": True}
+    lock = threading.Lock()
+
+    class _SlowOnce(_CountingEngine):
+        def run(self, requests):
+            if any(r.id == "q500" for r in requests):
+                with lock:
+                    hit = stall_once["armed"]
+                    stall_once["armed"] = False
+                if hit:
+                    self.unstall.wait(timeout=5.0)
+            with self._lock:
+                self.runs.extend(r.id for r in requests)
+            return [SimpleNamespace(id=r.id, mel_len=1)
+                    for r in requests]
+
+    engines = {}
+
+    def factory(rid):
+        engines[rid] = _SlowOnce()
+        return engines[rid]
+
+    router, procs, reg = _make_cluster(
+        replicas=2, engine_factory=factory,
+        hedge_quantile=0.95, hedge_min_ms=50.0, hedge_max_ms=150.0,
+    )
+    try:
+        assert router.wait_ready(timeout=20, n=2)
+        fut = router.submit(SynthesisRequest(
+            id="q500", sequence=np.ones(8, np.int32),
+            ref_mel=np.zeros((4, 80), np.float32)))
+        result = fut.result(timeout=10)
+        assert result.served_by
+        assert reg.value("serve_hedge_fired_total",
+                         {"class": "interactive"}) == 1
+        assert reg.value("serve_hedge_won_total",
+                         {"class": "interactive"}) == 1
+    finally:
+        for p in procs.values():
+            p.engine.unstall.set()
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# consuming surfaces: healthz aggregation + autoscaler floor
+# ---------------------------------------------------------------------------
+
+
+def test_server_stats_aggregates_cluster_block():
+    from speakingstyle_tpu.serving.server import SynthesisServer
+
+    router, procs, reg = _make_cluster(replicas=1, quorum=1)
+    server = None
+    try:
+        assert router.wait_ready(timeout=20, n=1)
+        server = SynthesisServer(router=router, host="127.0.0.1", port=0)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        stats = server.stats()
+        assert stats["ready"] is True
+        cluster = stats["cluster"]
+        assert cluster["quorum"] == 1
+        assert cluster["control_addr"] == router.control_addr
+        row = cluster["replicas"][0]
+        assert row["ready"] and not row["partitioned"]
+        assert ":" in row["host"]
+    finally:
+        if server is not None:
+            server.shutdown()
+        else:
+            router.close()
+
+
+def test_autoscaler_respects_cluster_scale_floor():
+    """A ClusterRouter publishes its quorum as scale_floor; the
+    autoscaler treats it as a hard floor — an under-quorum fleet is
+    corrected up immediately, and calm never drains below it."""
+    from speakingstyle_tpu.serving.autoscale import Autoscaler
+
+    calls = []
+    fake = SimpleNamespace(
+        registry=MetricsRegistry(), events=None,
+        fleet=SimpleNamespace(queue_depth=64),
+        scale_floor=2, rollout_active=False,
+        live_replica_count=lambda: 1,
+        pending_depth=lambda: 0, occupancy=lambda: 0.0,
+        warmup_cost_s=lambda: None,
+        scale_to=lambda n: calls.append(n),
+    )
+    acfg = AutoscaleConfig(enabled=True, min_replicas=1, max_replicas=4)
+    a = Autoscaler(fake, acfg, start=False)
+    assert a.step(now=100.0) == "min_bound"
+    assert calls == [2]
+    # at the floor, a long calm window never drains below it
+    fake.live_replica_count = lambda: 2
+    for t in range(200, 2000, 100):
+        assert a.step(now=float(t)) is None
+    assert calls == [2]
+
+
+def test_remote_engine_surface_matches_router_contract():
+    """The RemoteReplica interface rollout/autoscale drive: no vocoder
+    (streaming stays in-process), compile_count via /healthz, is_ready
+    tied to the lease."""
+    router, procs, reg = _make_cluster(replicas=1, quorum=1)
+    try:
+        assert router.wait_ready(timeout=20, n=1)
+        eng = router._replicas[0].engine
+        assert eng.vocoder is None
+        assert eng.is_ready is True
+        assert eng.compile_count == 0   # toy engine: nothing compiled
+        router.partition(eng.replica_id)
+        assert _wait(lambda: not eng.is_ready, timeout=5)
+    finally:
+        router.close()

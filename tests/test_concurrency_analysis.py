@@ -504,8 +504,13 @@ def test_committed_lockorder_is_current_and_acyclic():
     # and the known real nestings are present
     pairs = {(e["before"], e["after"]) for e in art["edges"]}
     assert ("FleetRouter._cond", "DrainRateEstimator._lock") in pairs
-    assert ("SynthesisEngine._lock", "ProgramRegistry._lock") in pairs
+    assert ("StyleService._compile_lock", "ProgramRegistry._lock") in pairs
     assert ("RolloutManager._lock", "FleetRouter._cond") in pairs
+    assert ("ClusterRouter._proc_lock", "LeaseTable._lock") in pairs
+    # the warming-state guard (r17) moved re-warm compiles OFF the
+    # engine lock: an engine-lock -> registry-lock nesting reappearing
+    # would mean compiles block dispatch again
+    assert ("SynthesisEngine._lock", "ProgramRegistry._lock") not in pairs
 
 
 # ---------------------------------------------------------------------------
