@@ -3341,6 +3341,337 @@ def run_longform(duration: float = 3.0):
         }))
 
 
+# The distilled student is a different function, not a recast of the
+# same weights: after the smoke-length in-bench distillation its
+# golden-set RMS mel distance sits around 1.2-1.5 (vs ~0.1/~0.3 for the
+# bf16/int8 recasts of the teacher). 2.0 gives headroom over run-to-run
+# noise while still slamming the door on a broken student — non-finite,
+# empty, or unconverged output lands far above it.
+STUDENT_TIER_TOLERANCE = 2.0
+
+
+def _tiers_bench_config(tmp: str):
+    """Teacher config for the --tiers frontier: the tiny serve model
+    deepened to 2+2 transformer layers with a 64-wide FFN so the
+    student's halved depth/width is visible above CPU dispatch overhead,
+    but hidden kept at 16 — int8 dequant-on-read cost grows with
+    hidden^2 on CPU and at 32 it erases the student's win (measured).
+    Train paths point into ``tmp`` and the LR ramp is shortened
+    (train.loss.anneal_steps gates the ramp to anneal_lr) so the
+    smoke-length distillation actually moves."""
+    import dataclasses
+
+    from speakingstyle_tpu.configs.config import TiersConfig
+
+    base = _tiny_serve_config()
+    return dataclasses.replace(
+        base,
+        model=dataclasses.replace(
+            base.model,
+            transformer=dataclasses.replace(
+                base.model.transformer, encoder_layer=2, decoder_layer=2,
+                conv_filter_size=64,
+            ),
+            postnet_layers=4,
+        ),
+        serve=dataclasses.replace(
+            base.serve,
+            batch_buckets=[1, 4],
+            fleet=dataclasses.replace(
+                base.serve.fleet,
+                class_deadline_ms={"interactive": 250.0, "batch": 2000.0,
+                                   "long_form": 8000.0},
+            ),
+            tiers=TiersConfig(
+                enabled=True,
+                precisions=["f32", "bf16", "int8"],
+                class_tier={"interactive": "student-int8",
+                            "batch": "teacher-bf16",
+                            "long_form": "teacher-f32"},
+                default_tier="teacher-f32",
+                tier_tolerance=0.5,
+                golden_set_size=4,
+            ),
+        ),
+        train=dataclasses.replace(
+            base.train,
+            path=dataclasses.replace(
+                base.train.path,
+                ckpt_path=os.path.join(tmp, "ckpt"),
+                log_path=os.path.join(tmp, "log"),
+            ),
+            step=dataclasses.replace(
+                base.train.step, total_step=80, log_step=40, save_step=80,
+            ),
+            loss=dataclasses.replace(base.train.loss, anneal_steps=5),
+        ),
+    )
+
+
+def run_tiers(duration: float = 3.0, distill_steps: int = 80):
+    """The --tiers drill: the quality-vs-speed frontier over the
+    precision lattice (teacher at f32/bf16/int8) and the distilled fast
+    tier (student at f32/int8), each canary-gated against the
+    teacher-f32 anchor before it may ship.
+
+    Per tier it emits one {"metric": "serve_tier"} line — golden-set
+    mel_l2 from the quality gate, a MOS proxy derived from it, batch-1
+    closed-loop latency p50/p999 (the TTFA proxy on CPU), QPS, and the
+    CompileMonitor count (must be zero: every tier serves off the AOT
+    lattice). A mixed-tier phase then routes classes through ONE
+    TierRouter over per-tier FleetRouters and the closing
+    {"metric": "serve_tier_frontier"} line reports the routed fast
+    tier's speedup vs the anchor plus per-tier dispatch counts. Rides
+    ``--compare`` as the ``tier_*`` keys; any SHIPPED tier whose
+    mel_l2 exceeds its tolerance hard-fails the diff there.
+    """
+    import dataclasses
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.models.hifigan import Generator
+    from speakingstyle_tpu.obs import MetricsRegistry
+    from speakingstyle_tpu.serving.engine import (
+        CompileMonitor,
+        SynthesisEngine,
+        SynthesisRequest,
+    )
+    from speakingstyle_tpu.serving.fleet import FleetRouter
+    from speakingstyle_tpu.serving.lattice import BucketLattice
+    from speakingstyle_tpu.serving.tiers import (
+        TierRouter,
+        parse_tier,
+        tier_gate,
+    )
+    from speakingstyle_tpu.training.distill import run_distillation
+
+    _mark("building tiers teacher")
+    tmp = tempfile.mkdtemp(prefix="bench_tiers_")
+    cfg = _tiers_bench_config(tmp)
+    lattice = BucketLattice.from_config(cfg.serve)
+    n_position = max(lattice.max_mel, lattice.max_src,
+                     cfg.model.max_seq_len) + 1
+    t_model = build_model(cfg, n_position=n_position)
+    t_vars = init_variables(t_model, cfg, jax.random.PRNGKey(0))
+    # random weights free-run ~zero durations -> empty gate outputs; the
+    # serving tests' duration bias makes the teacher (and, through
+    # teacher-forced durations, the distilled student) speak
+    dp = t_vars["params"]["variance_adaptor"]["duration_predictor"]
+    dp["linear_layer"]["bias"] = dp["linear_layer"]["bias"] + 1.1
+    gen = Generator(
+        upsample_rates=(2, 2), upsample_kernel_sizes=(4, 4),
+        upsample_initial_channel=16, resblock_kernel_sizes=(3,),
+        resblock_dilation_sizes=((1,),),
+    )
+    n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+    gparams = gen.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8, n_mels), np.float32)
+    )["params"]
+    teacher = SynthesisEngine(
+        cfg, t_vars, vocoder=(gen, gparams), lattice=lattice, model=t_model
+    )
+    t0 = time.perf_counter()
+    teacher.precompile()
+    teacher_compiles = teacher.compile_count
+    _mark(f"teacher precompiled {teacher_compiles} programs in "
+          f"{time.perf_counter() - t0:.1f}s "
+          f"({len(lattice)} points x {lattice.precisions})")
+
+    _mark(f"distilling student ({distill_steps} steps)")
+    t0 = time.perf_counter()
+    state, s_cfg = run_distillation(
+        cfg, teacher_variables=t_vars, max_steps=distill_steps,
+        batch_size=4, log=False,
+    )
+    distill_s = time.perf_counter() - t0
+    s_vars = {"params": state.params, "batch_stats": state.batch_stats}
+    s_serve_cfg = dataclasses.replace(s_cfg, serve=dataclasses.replace(
+        s_cfg.serve,
+        tiers=dataclasses.replace(cfg.serve.tiers,
+                                  precisions=["f32", "int8"]),
+    ))
+    s_lattice = BucketLattice.from_config(s_serve_cfg.serve)
+    s_model = build_model(s_serve_cfg, n_position=n_position)
+    student = SynthesisEngine(
+        s_serve_cfg, s_vars, vocoder=(gen, gparams), lattice=s_lattice,
+        model=s_model,
+    )
+    t0 = time.perf_counter()
+    student.precompile()
+    student_compiles = student.compile_count
+    _mark(f"student precompiled {student_compiles} programs in "
+          f"{time.perf_counter() - t0:.1f}s; distill took {distill_s:.1f}s")
+
+    def n_params(variables):
+        return int(sum(x.size for x in
+                       jax.tree_util.tree_leaves(variables["params"])))
+
+    rng = np.random.default_rng(0)
+    max_ref = cfg.serve.style.ref_buckets[-1]
+    hot_refs = [
+        rng.standard_normal(
+            (int(rng.integers(max(8, max_ref // 2), max_ref + 1)), n_mels)
+        ).astype(np.float32)
+        for _ in range(8)
+    ]
+    max_len = min(cfg.serve.src_buckets[-1],
+                  cfg.serve.mel_buckets[-1] // cfg.serve.frames_per_phoneme)
+
+    def make_request(i: int, precision=None, priority=None):
+        L = int(rng.integers(max(4, max_len // 2), max_len + 1))
+        return SynthesisRequest(
+            id=f"tier{i}",
+            sequence=rng.integers(1, 300, L).astype(np.int32),
+            ref_mel=hot_refs[i % len(hot_refs)],
+            precision=precision,
+            priority=priority,
+        )
+
+    # (tier name, engine, gate tolerance override); the anchor gates
+    # itself by identity and carries the config default tolerance
+    tiers = (
+        ("teacher-f32", teacher, None),
+        ("teacher-bf16", teacher, None),
+        ("teacher-int8", teacher, None),
+        ("student-f32", student, STUDENT_TIER_TOLERANCE),
+        ("student-int8", student, STUDENT_TIER_TOLERANCE),
+    )
+    p50_by_tier = {}
+    qps_by_tier = {}
+    gates = {}
+    all_zero_compiles = True
+    for name, engine, tol in tiers:
+        spec = parse_tier(name)
+        if name == "teacher-f32":
+            gate = None
+            mel_l2, tolerance = 0.0, cfg.serve.tiers.tier_tolerance
+            shipped, gate_detail, gate_ms = True, "ungated anchor", 0.0
+        else:
+            gate = tier_gate(engine, teacher, cfg, name, tolerance=tol)
+            gates[name] = gate
+            mel_l2, tolerance = gate.mel_l2, gate.tolerance
+            shipped, gate_detail, gate_ms = (gate.shipped, gate.detail,
+                                             gate.gate_ms)
+        # first-execution transfer warmup at this precision (compiles
+        # already happened in precompile)
+        for j in range(5):
+            engine.run([make_request(10_000 + j, precision=spec.precision)])
+        lat = []
+        with CompileMonitor() as mon:
+            t0 = time.perf_counter()
+            i = 0
+            while time.perf_counter() - t0 < duration:
+                a = time.perf_counter()
+                engine.run([make_request(i, precision=spec.precision)])
+                lat.append((time.perf_counter() - a) * 1e3)
+                i += 1
+            dt = time.perf_counter() - t0
+        lat.sort()
+        p50 = lat[len(lat) // 2]
+        p999 = lat[min(len(lat) - 1, int(len(lat) * 0.999))]
+        qps = len(lat) / dt
+        p50_by_tier[name] = p50
+        qps_by_tier[name] = qps
+        all_zero_compiles = all_zero_compiles and mon.count == 0
+        print(json.dumps({
+            "metric": "serve_tier",
+            "tier": name,
+            "precision": spec.precision,
+            "n_params": n_params(t_vars if spec.model == "teacher"
+                                 else s_vars),
+            "qps": round(qps, 2),
+            "ttfa_p50_ms": round(p50, 3),
+            "ttfa_p999_ms": round(p999, 3),
+            "steady_compiles": mon.count,
+            "mel_l2": round(mel_l2, 4),
+            "tolerance": tolerance,
+            "mel_l2_over_tolerance": round(mel_l2 / tolerance, 4),
+            # a coarse quality stand-in so the frontier has a quality
+            # axis in one number; NOT a listening test
+            "mos_proxy": round(max(1.0, 5.0 - 1.5 * mel_l2), 2),
+            "shipped": shipped,
+            "gate_ms": round(gate_ms, 1),
+            "gate_detail": gate_detail,
+            "unit": "ms batch-1 closed-loop engine dispatch "
+                    "(TTFA proxy on cpu)",
+            "model": "tiny-cpu",
+            "platform": "cpu-proxy",
+        }))
+
+    # mixed-tier phase: ONE TierRouter over per-tier fleets (each
+    # replicas=1, sharing the precompiled engines), driven by a single
+    # closed-loop client cycling the traffic classes — records that
+    # class->tier routing + per-tier dispatch counters work end to end
+    _mark("mixed-tier routing phase")
+    registry = MetricsRegistry()
+    router = TierRouter(cfg, registry=registry)
+    routed = (
+        ("teacher-f32", teacher, cfg, None),
+        ("teacher-bf16", teacher, cfg, gates["teacher-bf16"]),
+        ("student-int8", student, s_serve_cfg, gates["student-int8"]),
+    )
+    for name, engine, tier_cfg, gate in routed:
+        fleet = FleetRouter(
+            lambda reg, e=engine: e, tier_cfg, replicas=1,
+            registry=registry, tier=name,
+        )
+        fleet.wait_ready(timeout=120, n=1)
+        router.add_tier(name, fleet, gate=gate)
+    classes = ("interactive", "batch", "long_form")
+    mixed_done = 0
+    with CompileMonitor() as mon:
+        stop_at = time.perf_counter() + duration
+        i = 0
+        while time.perf_counter() < stop_at:
+            req = make_request(1_000_000 + i,
+                               priority=classes[i % len(classes)])
+            router.submit(req).result(timeout=60)
+            mixed_done += 1
+            i += 1
+    dispatch = {
+        name: int(registry.counter("serve_tier_dispatch_total",
+                                   labels={"tier": name}).value)
+        for name in router.tiers()
+    }
+    routing = router.routing_table()
+    fast_tier = routing.get("interactive", router.default_tier)
+    router.close()
+
+    anchor_p50 = p50_by_tier["teacher-f32"]
+    fast_p50 = p50_by_tier.get(fast_tier)
+    print(json.dumps({
+        "metric": "serve_tier_frontier",
+        "anchor": "teacher-f32",
+        "fast_tier": fast_tier,
+        "speedup_ttfa_p50": (round(anchor_p50 / fast_p50, 3)
+                             if fast_p50 else None),
+        "speedup_qps": (round(qps_by_tier[fast_tier]
+                              / qps_by_tier["teacher-f32"], 3)
+                        if fast_tier in qps_by_tier else None),
+        "tiers_shipped": sorted(
+            ["teacher-f32"] + [n for n, g in gates.items() if g.shipped]
+        ),
+        "zero_steady_compiles": all_zero_compiles and mon.count == 0,
+        "mixed_requests": mixed_done,
+        "mixed_steady_compiles": mon.count,
+        "dispatch": dispatch,
+        "routing": routing,
+        "aot_programs": {"teacher": teacher_compiles,
+                         "student": student_compiles},
+        "distill_seconds": round(distill_s, 1),
+        "model": "tiny-cpu",
+        "platform": "cpu-proxy",
+        "note": "CPU proxy: batch-1 engine dispatch stands in for TTFA "
+                "and int8 pays a dequant-on-read tax CPUs never "
+                "amortize; real int8 speedups await the chip campaign "
+                "(ROADMAP item 5)",
+    }))
+
+
 REGRESSION_THRESHOLD = 0.10
 
 
@@ -3491,6 +3822,28 @@ def _absorb_record(rec, metrics):
         if isinstance(rec.get("steady_compiles"), (int, float)):
             metrics[f"meshserve_steady_compiles_{g}"] = (
                 float(rec["steady_compiles"]), "lower")
+    elif m == "serve_tier":
+        # one quality-tier frontier point; mel_l2_over_tolerance rides
+        # ONLY for shipped tiers (a gated-out tier was correctly kept
+        # off the routing table — its distance is a report, not a
+        # regression) and carries a hard >1.0 gate in run_compare
+        t = rec.get("tier")
+        if isinstance(rec.get("qps"), (int, float)):
+            metrics[f"tier_{t}_qps"] = (float(rec["qps"]), "higher")
+        for k in ("ttfa_p50_ms", "ttfa_p999_ms", "steady_compiles"):
+            if isinstance(rec.get(k), (int, float)):
+                metrics[f"tier_{t}_{k}"] = (float(rec[k]), "lower")
+        if rec.get("shipped") and isinstance(
+                rec.get("mel_l2_over_tolerance"), (int, float)):
+            metrics[f"tier_{t}_mel_l2_over_tolerance"] = (
+                float(rec["mel_l2_over_tolerance"]), "lower")
+    elif m == "serve_tier_frontier":
+        if isinstance(rec.get("speedup_ttfa_p50"), (int, float)):
+            metrics["tier_frontier_speedup_ttfa_p50"] = (
+                float(rec["speedup_ttfa_p50"]), "higher")
+        if isinstance(rec.get("mixed_steady_compiles"), (int, float)):
+            metrics["tier_mixed_steady_compiles"] = (
+                float(rec["mixed_steady_compiles"]), "lower")
     elif m == "serve_style_cache_qps_gain":
         if isinstance(rec.get("value"), (int, float)):
             metrics[m] = (float(rec["value"]), "higher")
@@ -3604,6 +3957,20 @@ def run_compare(old_path, new_path=None, threshold=REGRESSION_THRESHOLD,
               f"{os.path.basename(new_path)}; the canary-gated roll "
               "must drain-replace without dropping in-flight work",
               file=out)
+        return 1
+    # quality hard gate for the tier frontier: any SHIPPED tier whose
+    # golden-set mel_l2 exceeds its tolerance is a quality outage, not
+    # a 10%-threshold matter — the canary gate exists to keep such a
+    # tier out of the routing table, so seeing one in an artifact means
+    # the quality door itself failed
+    over = [k for k, v in sorted(new.items())
+            if k.startswith("tier_")
+            and k.endswith("_mel_l2_over_tolerance") and v[0] > 1.0]
+    if over:
+        print(f"FAIL: shipped tier(s) beyond quality tolerance in "
+              f"{os.path.basename(new_path)}: {', '.join(over)}; every "
+              "shipped tier's golden-set mel_l2 must hold under its "
+              "serve.tiers tolerance", file=out)
         return 1
     common = sorted(set(old) & set(new))
     if not common:
@@ -3731,6 +4098,11 @@ if __name__ == "__main__":
         run_cluster(duration=dur)
         run_mesh_serve(duration=dur)
         run_longform(duration=dur)
+        run_tiers(duration=dur)
+    elif "--tiers" in sys.argv:
+        dur = (float(sys.argv[sys.argv.index("--duration") + 1])
+               if "--duration" in sys.argv else 3.0)
+        run_tiers(duration=dur)
     elif "--rollout" in sys.argv:
         dur = (float(sys.argv[sys.argv.index("--duration") + 1])
                if "--duration" in sys.argv else 3.0)

@@ -5,6 +5,7 @@ import sys
 
 COMMANDS = (
     "train",
+    "distill",
     "evaluate",
     "synthesize",
     "preprocess",

@@ -131,6 +131,15 @@ JL024  unbounded wire call in serving code: an HTTP/socket client
        default (socket.setdefaulttimeout) is process-global state and
        does NOT count: the bound must be visible at the call site.
        Tree baseline: zero.
+JL025  out-of-band weight-tree precision cast: ``<tree>.astype(...)``,
+       a ``jnp.float32(<tree>)``-style dtype constructor, or a
+       ``tree_map(lambda x: x.astype(...), <tree>)`` over a
+       params/variables tree anywhere outside the sanctioned
+       ``cast_params`` helper in parallel/registry.py. Precision is a
+       lattice axis: the registry cache key, ProgramCard rows, and the
+       tier canary gates all key on which precision a param tree
+       carries, so an inline cast serves weights no gate approved and
+       no card records. Tree baseline: zero.
 """
 
 import ast
@@ -2510,6 +2519,103 @@ def rule_jl024(mod: ModuleInfo) -> Iterator[Finding]:
         )
 
 
+_DTYPE_CTORS = frozenset((
+    "float32", "bfloat16", "float16", "float64", "int8", "int4",
+))
+
+
+def _is_weight_tree(node) -> bool:
+    """A params/variables tree by name: ``params``/``variables``, a
+    ``*_params``/``*_variables`` local, or an attribute chain ending in
+    one (``state.params``, ``self.variables``)."""
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    else:
+        return False
+    return name in ("params", "variables") or name.endswith(
+        ("_params", "_variables")
+    )
+
+
+def rule_jl025(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL025: a precision cast of a weight tree outside the sanctioned
+    ``cast_params`` helper — ``<tree>.astype(...)``, a
+    ``jnp.float32(<tree>)``-style dtype constructor, or a
+    ``tree_map(lambda x: x.astype(...), <tree>)`` over a
+    params/variables tree anywhere in ``speakingstyle_tpu/`` except
+    ``parallel/registry.py``.
+
+    Precision is a lattice axis, not a local convenience: the registry's
+    cache key, the ProgramCard rows, the BufferPool dtypes, and the tier
+    gates all key on which precision a param tree carries. A cast done
+    inline at a call site produces weights the choke point never saw —
+    a program compiles and serves at a precision no canary gated and no
+    card records, which is exactly the same-bucket-different-precision
+    blindness the tier door exists to close. All weight-tree casts flow
+    through ``parallel/registry.py``'s ``cast_params`` (bf16 cast,
+    int8 per-channel quant) / ``dequant_params`` (in-program f32 read).
+    """
+    p = mod.path.replace("\\", "/")
+    if "speakingstyle_tpu/" not in p or p.endswith("parallel/registry.py"):
+        return
+    for node in mod.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func) or ""
+        leaf = dotted.rsplit(".", 1)[-1]
+        bad = None
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "astype"
+                and _is_weight_tree(node.func.value)):
+            bad = f"{_dotted(node.func.value)}.astype(...)"
+        elif (leaf in _DTYPE_CTORS
+                and dotted.startswith(("jnp.", "jax.numpy.", "np.", "numpy."))
+                and node.args and _is_weight_tree(node.args[0])):
+            bad = f"{dotted}({_dotted(node.args[0])})"
+        elif leaf in ("tree_map", "map") and dotted.startswith(
+                ("jax.", "tree_map", "tree.")):
+            # tree_map(lambda x: x.astype(...), params): the cast hides
+            # in the mapped lambda, the tree names the weights
+            if not any(_is_weight_tree(a) for a in node.args[1:]):
+                continue
+            fn_arg = node.args[0] if node.args else None
+            if not isinstance(fn_arg, ast.Lambda):
+                continue
+            for inner in ast.walk(fn_arg.body):
+                if not isinstance(inner, ast.Call):
+                    continue
+                idotted = _dotted(inner.func) or ""
+                ileaf = idotted.rsplit(".", 1)[-1]
+                if (isinstance(inner.func, ast.Attribute)
+                        and inner.func.attr == "astype") or (
+                        ileaf in _DTYPE_CTORS and idotted.startswith(
+                            ("jnp.", "jax.numpy.", "np.", "numpy."))):
+                    bad = f"{dotted}(lambda: ...{ileaf}(...), <weights>)"
+                    break
+        if bad is None:
+            continue
+        fn = mod.enclosing_function(node)
+        qual = mod.qualname(fn or mod.tree)
+        yield Finding(
+            rule="JL025",
+            path=mod.path,
+            line=node.lineno,
+            context=qual,
+            detail=f"out-of-band weight-tree cast: {bad}",
+            message=(
+                f"`{bad}` in {qual} casts a weight tree outside the "
+                "sanctioned helper: the registry cache key, ProgramCards, "
+                "and tier canary gates never see this precision, so a "
+                "program can serve quantized/cast weights no gate "
+                "approved. Route the cast through cast_params() in "
+                "parallel/registry.py (dequant_params for in-program "
+                "int8 reads)."
+            ),
+        )
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -2535,4 +2641,5 @@ RULES = {
     "JL022": rule_jl022,
     "JL023": rule_jl023,
     "JL024": rule_jl024,
+    "JL025": rule_jl025,
 }

@@ -1,0 +1,77 @@
+"""``distill`` command: train the fast-tier student acoustic model.
+
+Distills the teacher checkpoint under ``train.path.ckpt_path`` into a
+halved-depth/width student (training/distill.py), checkpointing under
+``<ckpt_path>/student`` as a second model version the tier gates
+(serving/tiers.py) canary against the teacher.
+"""
+
+import argparse
+
+from speakingstyle_tpu.cli import add_config_args, config_from_args
+
+
+def build_parser(parser=None):
+    parser = parser or argparse.ArgumentParser(description=__doc__)
+    add_config_args(parser, required=True)
+    parser.add_argument(
+        "--max_steps", type=int, default=None,
+        help="override total_step for the distill run (smoke tests)",
+    )
+    parser.add_argument(
+        "--batch_size", type=int, default=8,
+        help="synthetic distill batch size (static shape: one compile)",
+    )
+    parser.add_argument(
+        "--src_len", type=int, default=None,
+        help="phoneme length of the synthetic batches (default: the "
+        "golden-set length, min(serve.src_buckets[0], 12))",
+    )
+    parser.add_argument(
+        "--fresh_teacher", action="store_true",
+        help="distill against a seeded fresh-init teacher even if a "
+        "checkpoint exists (drills/bench: exercises the full loop "
+        "without a trained teacher)",
+    )
+    parser.add_argument(
+        "--faults", type=str, default=None,
+        help="deterministic fault-injection spec for resilience drills, "
+        "e.g. 'nan_grads@120;sigterm@500' (sets SPEAKINGSTYLE_FAULTS; "
+        "see training/faults.py for the grammar)",
+    )
+    return parser
+
+
+def main(args):
+    import os
+
+    if args.faults:
+        from speakingstyle_tpu.training.faults import ENV_VAR, FaultPlan
+
+        FaultPlan.parse(args.faults)  # validate the spec before training
+        os.environ[ENV_VAR] = args.faults
+
+    cfg = config_from_args(args)
+    teacher_variables = None
+    if args.fresh_teacher:
+        import jax
+
+        from speakingstyle_tpu.models.factory import build_model, init_variables
+
+        teacher_variables = init_variables(
+            build_model(cfg), cfg, jax.random.PRNGKey(cfg.train.seed)
+        )
+    from speakingstyle_tpu.training.distill import run_distillation
+
+    state, _ = run_distillation(
+        cfg,
+        teacher_variables=teacher_variables,
+        max_steps=args.max_steps,
+        batch_size=args.batch_size,
+        src_len=args.src_len,
+    )
+    print(f"distillation finished at step {int(state.step)}")
+
+
+if __name__ == "__main__":
+    main(build_parser().parse_args())

@@ -1093,6 +1093,77 @@ class ClusterConfig:
 
 
 @dataclass(frozen=True)
+class TiersConfig:
+    """Quality-tiered serving (serving/tiers.py): precision variants of
+    the acoustic lattice plus an optional distilled student model,
+    canary-gated against the teacher and routed by traffic class.
+
+    A tier name is ``<model>-<precision>`` (``teacher-f32``,
+    ``teacher-bf16``, ``student-int8``): the model half picks the param
+    tree (teacher checkpoint vs the distilled student registered as a
+    second model version), the precision half picks the lattice's
+    precision axis. A tier only ships if its golden-set mel-L2 against
+    the teacher-f32 engine holds under ``tier_tolerance``; a failed gate
+    falls back to ``default_tier`` so routing never loses requests.
+    """
+
+    enabled: bool = False
+    # precision tiers the lattice compiles (registry.PRECISIONS subset;
+    # the first entry is the default precision for untagged requests)
+    precisions: List[str] = field(default_factory=lambda: ["f32"])
+    # traffic class -> tier name; classes absent here ride default_tier
+    class_tier: Dict[str, str] = field(default_factory=dict)
+    # the always-shipped reference tier (the quality anchor; its gate is
+    # identity so it can never fail)
+    default_tier: str = "teacher-f32"
+    # golden-set mel-L2 ceiling vs the teacher-f32 engine for a tier to
+    # ship (same spirit as rollout.canary_tolerance; loose default for
+    # tiny CI configs — production presets tighten it)
+    tier_tolerance: float = 1e3
+    # golden probe set (reuses lifecycle.make_golden_set)
+    golden_set_size: int = 4
+    golden_seed: int = 0
+    # the distilled student checkpoint (training/distill.py output);
+    # empty = no student tiers available
+    student_ckpt_path: str = ""
+
+    def __post_init__(self):
+        allowed = ("f32", "bf16", "int8")
+        if not self.precisions:
+            raise ValueError("serve.tiers.precisions must be non-empty")
+        for p in self.precisions:
+            if p not in allowed:
+                raise ValueError(
+                    f"serve.tiers.precisions entries must be in {allowed}, "
+                    f"got {p!r}"
+                )
+        if len(set(self.precisions)) != len(self.precisions):
+            raise ValueError(
+                f"serve.tiers.precisions must be unique, got {self.precisions}"
+            )
+        names = [self.default_tier, *self.class_tier.values()]
+        for name in names:
+            model, sep, prec = name.partition("-")
+            if not sep or model not in ("teacher", "student") \
+                    or prec not in allowed:
+                raise ValueError(
+                    "tier names must be '<model>-<precision>' with model in "
+                    f"(teacher, student) and precision in {allowed}, "
+                    f"got {name!r}"
+                )
+        if self.tier_tolerance <= 0:
+            raise ValueError(
+                f"serve.tiers.tier_tolerance must be > 0, "
+                f"got {self.tier_tolerance}"
+            )
+        if self.golden_set_size <= 0:
+            raise ValueError(
+                f"serve.tiers.golden_set_size must be > 0, "
+                f"got {self.golden_set_size}"
+            )
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Continuous-batching synthesis server knobs (serving/engine.py,
     serving/batcher.py).
@@ -1158,6 +1229,10 @@ class ServeConfig:
     # long-form (chapter-length) synthesis: chunk+stitch tier always on,
     # ring-attention tier when longform.mesh_seq > 1
     longform: LongformConfig = field(default_factory=LongformConfig)
+    # quality tiers: precision lattice axis + distilled fast tier,
+    # canary-gated and routed by class (disabled by default — one
+    # teacher-f32 tier, byte-identical to the pre-tier engine)
+    tiers: TiersConfig = field(default_factory=TiersConfig)
     # mesh geometry of ONE replica (parallel/mesh.py resolve_mesh — the
     # same resolution path as train.parallel): [1, 1] keeps the
     # single-device engine byte-for-byte; [dp, tp] makes every replica a

@@ -47,6 +47,16 @@ Governance the registry provides uniformly:
 wrappers (the trainer's step functions, bench micro-timers, the audio
 DSP decorators): a thin alias of ``jax.jit`` that exists so JL018 can
 insist the spelling ``jax.jit`` appears nowhere else in the tree.
+
+Precision is a registry concern too: ``cast_params``/``dequant_params``
+are the ONE sanctioned path for converting a weight tree between
+serving precisions (``f32``/``bf16``/``int8``) — jaxlint JL025 makes
+that structural the same way JL018 does for compiles, so a quantized
+program's numerics are auditable in one place. ``compile`` takes a
+``precision=`` tag that folds into the cache key and lands on the
+ProgramCard row: two programs at the same shape bucket but different
+precisions are distinct cache entries, and ``GET /debug/programs``
+proves not just WHAT compiled but HOW SMALL.
 """
 
 import contextlib
@@ -56,10 +66,27 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from speakingstyle_tpu.obs.locks import make_lock
 
 __all__ = [
+    "PRECISIONS",
     "ProgramRegistry",
+    "cast_params",
+    "dequant_params",
     "jit_program",
     "quiet_donation",
 ]
+
+# The serving precision axis, widest first. "f32" is the identity tier;
+# "bf16" casts float leaves; "int8" stores per-channel symmetric-quantized
+# weights that are dequantized to f32 on read inside the compiled program.
+PRECISIONS = ("f32", "bf16", "int8")
+
+# Marker keys of the int8 leaf representation: a plain dict holding the
+# quantized tensor and its per-channel f32 scale. A dict (not a custom
+# pytree node) flows through tree_map / device_put / shardings untouched.
+_INT8_KEYS = frozenset(("int8_q", "int8_scale"))
+
+
+def _is_int8_leaf(x: Any) -> bool:
+    return isinstance(x, dict) and set(x.keys()) == set(_INT8_KEYS)
 
 
 @contextlib.contextmanager
@@ -90,6 +117,77 @@ def jit_program(fn: Optional[Callable] = None, **jit_kwargs):
     if fn is None:
         return functools.partial(jit_program, **jit_kwargs)
     return jax.jit(fn, **jit_kwargs)
+
+
+def cast_params(variables: Any, precision: str) -> Any:
+    """The sanctioned precision cast: one weight tree in, one serving
+    param tree out (jaxlint JL025 forbids spelling this anywhere else).
+
+    * ``"f32"`` — identity (the tree is already the full-precision tier).
+    * ``"bf16"`` — every float leaf becomes ``bfloat16``; integer leaves
+      (embedding tables' index vectors, step counters) pass through.
+    * ``"int8"`` — every float matrix/tensor leaf (ndim >= 2) becomes a
+      per-channel symmetric-quantized ``{"int8_q", "int8_scale"}`` pair:
+      the scale is ``amax/127`` over all leading axes (one scale per
+      output channel, the last axis), weights round-clip into int8, and
+      ``dequant_params`` restores f32 on read inside the compiled
+      program. Small leaves (biases, LayerNorm vectors, scalars) stay
+      f32 — quantizing them saves nothing and costs accuracy.
+
+    Runs on host numpy so param trees can be cast before ``device_put``
+    (int8 lives in HBM; dequant happens on-chip at dispatch).
+    """
+    import jax
+    import numpy as np
+
+    if precision not in PRECISIONS:
+        raise ValueError(
+            f"precision must be one of {PRECISIONS}, got {precision!r}"
+        )
+    if precision == "f32":
+        return variables
+
+    if precision == "bf16":
+        import jax.numpy as jnp
+
+        def to_bf16(x):
+            arr = np.asarray(x)
+            if np.issubdtype(arr.dtype, np.floating):
+                return jnp.asarray(arr, jnp.bfloat16)
+            return x
+
+        return jax.tree_util.tree_map(to_bf16, variables)
+
+    def to_int8(x):
+        arr = np.asarray(x)
+        if not np.issubdtype(arr.dtype, np.floating) or arr.ndim < 2:
+            return x
+        arr = arr.astype(np.float32)
+        axes = tuple(range(arr.ndim - 1))
+        amax = np.max(np.abs(arr), axis=axes, keepdims=True)
+        scale = (amax / 127.0).astype(np.float32)
+        scale = np.where(scale == 0.0, np.float32(1.0), scale)
+        q = np.clip(np.round(arr / scale), -127, 127).astype(np.int8)
+        return {"int8_q": q, "int8_scale": scale}
+
+    return jax.tree_util.tree_map(to_int8, variables)
+
+
+def dequant_params(variables: Any) -> Any:
+    """Restore an ``int8`` param tree to f32 — traceable, so it runs
+    INSIDE the compiled program (dequant-on-read: int8 occupies device
+    memory, each dispatch widens on-chip). Identity on trees without
+    int8 marker leaves, so callers can apply it unconditionally.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def widen(x):
+        if _is_int8_leaf(x):
+            return x["int8_q"].astype(jnp.float32) * x["int8_scale"]
+        return x
+
+    return jax.tree_util.tree_map(widen, variables, is_leaf=_is_int8_leaf)
 
 
 def _signature(tree: Any) -> str:
@@ -223,6 +321,7 @@ class ProgramRegistry:
         out_shardings=None,
         compiler_options: Optional[Dict] = None,
         labels: Optional[Dict[str, str]] = None,
+        precision: str = "f32",
     ):
         """(callable, sharding spec, shape bucket, donation spec) ->
         compiled executable, with the bookkeeping done.
@@ -237,9 +336,18 @@ class ProgramRegistry:
         ``fn`` may already be a jit wrapper (``jit_program`` output, the
         trainer's case) — it is lowered as-is and the jit construction
         kwargs must then be () / None.
+
+        ``precision`` tags which tier of the precision axis this program
+        serves (``f32``/``bf16``/``int8``); it folds into the cache key
+        (same bucket, different precision = different program) and onto
+        the card row.
         """
         import jax
 
+        if precision not in PRECISIONS:
+            raise ValueError(
+                f"precision must be one of {PRECISIONS}, got {precision!r}"
+            )
         key = (
             name,
             _signature(args),
@@ -247,6 +355,7 @@ class ProgramRegistry:
             repr(static_argnums),
             _sharding_str(in_shardings),
             _sharding_str(out_shardings),
+            precision,
         )
         with self._lock:
             exe = self._programs.get(key)
@@ -277,10 +386,11 @@ class ProgramRegistry:
             self._programs[key] = exe
             self._by_name[name] = exe
             self._record(exe, name, donate_argnums, in_shardings,
-                         out_shardings, labels)
+                         out_shardings, labels, precision)
         return exe
 
-    def _record(self, exe, name, donate, in_sh, out_sh, labels) -> None:
+    def _record(self, exe, name, donate, in_sh, out_sh, labels,
+                precision="f32") -> None:
         """Mint the ProgramCard, publish gauges, append the card row.
         Caller holds the lock. Card minting only reads compiler metadata
         — it can never itself compile."""
@@ -298,6 +408,7 @@ class ProgramRegistry:
         row["in_shardings"] = _sharding_str(in_sh)
         row["out_shardings"] = _sharding_str(out_sh)
         row["donate_argnums"] = list(donate)
+        row["precision"] = precision
         if labels:
             row.update({f"label_{k}": v for k, v in labels.items()})
         self._cards.append(row)

@@ -1002,6 +1002,7 @@ class ClusterRouter(FleetRouter):
         events: Optional[JsonlEventLog] = None,
         style=None,
         fault_plan: Optional[FaultPlan] = None,
+        tier: Optional[str] = None,
     ):
         ccfg = cfg.serve.cluster
         self.ccfg = ccfg
@@ -1041,7 +1042,7 @@ class ClusterRouter(FleetRouter):
         super().__init__(
             self._remote_factory, cfg, replicas=replicas,
             registry=registry, events=events, style=style,
-            fault_plan=fault_plan,
+            fault_plan=fault_plan, tier=tier,
         )
         self._lease_requeue_hist = self.registry.histogram(
             "serve_lease_requeue_seconds",

@@ -82,7 +82,11 @@ from speakingstyle_tpu.parallel.partition import (
     parse_rule_overrides,
     variables_shardings,
 )
-from speakingstyle_tpu.parallel.registry import ProgramRegistry
+from speakingstyle_tpu.parallel.registry import (
+    ProgramRegistry,
+    cast_params,
+    dequant_params,
+)
 from speakingstyle_tpu.serving.lattice import Bucket, BucketLattice, RequestTooLarge
 from speakingstyle_tpu.serving.pool import BufferPool
 from speakingstyle_tpu.serving.resilience import InjectedFault
@@ -142,6 +146,10 @@ class SynthesisRequest:
     # (the HTTP frontend's encoder call failed); carried through to the
     # result so the response can say X-Style-Degraded
     style_degraded: bool = False
+    # precision tier this request dispatches at (registry.PRECISIONS);
+    # None = the engine's default precision. Stamped by the TierRouter
+    # (serving/tiers.py) from the request's traffic class.
+    precision: Optional[str] = None
 
 
 @dataclass
@@ -167,6 +175,9 @@ class SynthesisResult:
     # process (RemoteEngine stamps it), None in-process — surfaced as
     # X-Served-By and joined into the http_request JSONL event
     served_by: Optional[str] = None
+    # quality tier that served this result ("teacher-f32", "student-int8",
+    # ...) — stamped by the tier's FleetRouter, surfaced as X-Model-Tier
+    tier: Optional[str] = None
 
 
 def _fill_control(rows: List[Control], out: np.ndarray) -> np.ndarray:
@@ -260,6 +271,36 @@ class SynthesisEngine:
                 self.vocoder = (gen, jax.device_put(
                     params, NamedSharding(self.mesh, PartitionSpec())
                 ))
+        # the precision axis (ROADMAP item 2): one param tree per tier,
+        # cast ONCE at construction through the sanctioned registry
+        # helper (JL025's choke point) — bf16 trees are plain casts,
+        # int8 trees hold {int8_q, int8_scale} leaves that the compiled
+        # program widens on read (dequant-on-read: int8 occupies device
+        # memory). The default ("f32",) axis keeps this a one-entry dict
+        # aliasing self.variables — byte-identical to the pre-tier engine.
+        self.precisions = tuple(
+            getattr(self.lattice, "precisions", None) or ("f32",)
+        )
+        self.default_precision = self.precisions[0]
+        self._params_by_precision: Dict[str, Dict] = {"f32": self.variables}
+        for prec in self.precisions:
+            if prec == "f32":
+                continue
+            tree = cast_params(variables, prec)
+            if self.mesh is not None:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                # quantized/cast trees replicate (tensor parallelism of
+                # non-f32 tiers waits on the real-chip campaign)
+                tree = jax.device_put(
+                    tree, NamedSharding(self.mesh, PartitionSpec())
+                )
+            self._params_by_precision[prec] = tree
+        # bf16 programs also COMPUTE in bf16 (a bf16 tree under f32
+        # matmuls would be a storage cast only); built lazily from the
+        # same module with the compute dtype swapped
+        self._bf16_model = None
         pp = cfg.preprocess.preprocessing
         self.n_mels = pp.mel.n_mel_channels
         self.max_wav_value = pp.audio.max_wav_value
@@ -306,12 +347,16 @@ class SynthesisEngine:
         self._request_rows = self.registry.counter(
             "serve_requests_total", help="requests served through dispatches"
         )
-        self._acoustic: Dict[Bucket, object] = {}
+        # acoustic programs key on (bucket, precision): same shape at two
+        # precisions = two distinct programs (the registry cache key
+        # agrees). The vocoder stays f32-only — its mel interface is the
+        # f32 contract every tier's acoustic output honors.
+        self._acoustic: Dict[Tuple[Bucket, str], object] = {}
         self._vocoder_exe: Dict[Tuple[int, int], object] = {}
         # per-program FLOPs cached out of the registry's card table at
         # compile time, so the dispatch hot path never takes the
         # registry lock for its achieved-FLOP/s arithmetic
-        self._acoustic_flops: Dict[Bucket, Optional[float]] = {}
+        self._acoustic_flops: Dict[Tuple[Bucket, str], Optional[float]] = {}
         self._vocoder_flops: Dict[Tuple[int, int], Optional[float]] = {}
         # compile-on-miss warming-state guard: the condition protects the
         # program tables and the ``_compiling`` key set ONLY — the XLA
@@ -385,10 +430,10 @@ class SynthesisEngine:
         payload — a mesh replica's programs show their partitioning)."""
         return self.program_registry.programs()
 
-    def _dispatch_flops(self, bucket: Bucket) -> Optional[float]:
+    def _dispatch_flops(self, bucket: Bucket, precision: str) -> Optional[float]:
         """Total card FLOPs one dispatch at ``bucket`` executes (acoustic
         + vocoder when present); None when the backend reported none."""
-        flops = [self._acoustic_flops.get(bucket)]
+        flops = [self._acoustic_flops.get((bucket, precision))]
         if self.vocoder is not None:
             flops.append(self._vocoder_flops.get((bucket.b, bucket.t_mel)))
         real = [f for f in flops if f]
@@ -396,14 +441,41 @@ class SynthesisEngine:
 
     # -- compilation --------------------------------------------------------
 
-    def _acoustic_fn(self, t_mel: int):
+    def _model_for(self, precision: str):
+        """The module a precision tier traces: bf16 programs compute in
+        bf16 (same params-tree structure, compute dtype swapped via
+        module clone); f32 and int8 (dequant-to-f32) trace the base
+        module unchanged."""
+        if precision != "bf16":
+            return self.model
+        if self._bf16_model is None:
+            import dataclasses
+
+            bf16_cfg = dataclasses.replace(
+                self.cfg,
+                model=dataclasses.replace(
+                    self.cfg.model, compute_dtype="bfloat16"
+                ),
+            )
+            self._bf16_model = self.model.clone(config=bf16_cfg)
+        return self._bf16_model
+
+    def _acoustic_fn(self, t_mel: int, precision: str = "f32"):
+        model = self._model_for(precision)
+        widen = precision == "int8"
+
         def fn(variables, speakers, texts, src_lens, gammas, betas,
                p_control, e_control, d_control):
             # no reference mel and no encoder in this program: FiLM
             # conditioning arrives precomputed (StyleService). A model
             # without the reference encoder ignores gammas/betas (XLA
             # dead-code-eliminates the unused inputs).
-            out = self.model.apply(
+            if widen:
+                # dequant-on-read, inside the trace: the program's input
+                # tree stays int8 in device memory; the f32 weights exist
+                # only transiently during execution
+                variables = dequant_params(variables)
+            out = model.apply(
                 variables,
                 speakers=speakers,
                 texts=texts,
@@ -464,11 +536,12 @@ class SynthesisEngine:
         precompile never blocks a live engine sharing the process.
         """
         t0 = time.monotonic()
-        for bucket in self.lattice.points():
-            self._ensure_program(
-                "acoustic", bucket, self._acoustic,
-                lambda b=bucket: self._compile_acoustic(b),
-            )
+        for prec in self.precisions:
+            for bucket in self.lattice.points():
+                self._ensure_program(
+                    "acoustic", (bucket, prec), self._acoustic,
+                    lambda b=bucket, p=prec: self._compile_acoustic(b, p),
+                )
         for b in self.lattice.batch_buckets:
             for t in self.lattice.mel_buckets:
                 self._ensure_program(
@@ -482,15 +555,16 @@ class SynthesisEngine:
             self.style.precompile()
         return time.monotonic() - t0
 
-    def _compile_acoustic(self, bucket: Bucket):
+    def _compile_acoustic(self, bucket: Bucket, precision: str = "f32"):
         import jax
         import jax.numpy as jnp
 
         b, l, t = bucket.b, bucket.l_src, bucket.t_mel
         s = jax.ShapeDtypeStruct
         d = self._film_dim
+        params = self._params_by_precision[precision]
         args = (
-            self.variables,
+            params,
             s((b,), jnp.int32),                        # speakers
             s((b, l), jnp.int32),                      # texts
             s((b,), jnp.int32),                        # src_lens
@@ -503,25 +577,43 @@ class SynthesisEngine:
         donate = tuple(range(1, 9)) if self.cfg.serve.donate_buffers else ()
         in_sh = out_sh = None
         if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
             # batch-leading args shard rows over ``data`` (replicated
             # when b doesn't divide); every output keeps its leading
             # batch axis, so the same spec carries out. _transfer uses
             # the identical rule — the compiled-in shardings and the
-            # dispatch-time device_puts must agree.
+            # dispatch-time device_puts must agree. Non-f32 trees
+            # replicate (their ctor device_put matches).
             bsh = dispatch_sharding(self.mesh, b)
-            in_sh = (self._var_shardings,) + (bsh,) * 8
+            var_sh = (
+                self._var_shardings if precision == "f32"
+                else NamedSharding(self.mesh, PartitionSpec())
+            )
+            in_sh = (var_sh,) + (bsh,) * 8
             out_sh = bsh
+        # f32 names stay byte-identical to the pre-tier engine; other
+        # precisions suffix the name AND the card label, so
+        # /debug/programs tells b4.s64.m512 from b4.s64.m512@int8
         label = bucket_label(bucket)
+        if precision != "f32":
+            label = f"{label}@{precision}"
         name = f"acoustic:{label}"
-        self._acoustic[bucket] = self.program_registry.compile(
-            self._acoustic_fn(t), args,
+        self._acoustic[(bucket, precision)] = self.program_registry.compile(
+            self._acoustic_fn(t, precision), args,
             name=name,
             donate_argnums=donate,
             in_shardings=in_sh,
             out_shardings=out_sh,
-            labels={"kind": "acoustic", "bucket": label},
+            labels=(
+                {"kind": "acoustic", "bucket": label}
+                if precision == "f32"
+                else {"kind": "acoustic", "bucket": label,
+                      "precision": precision}
+            ),
+            precision=precision,
         )
-        self._acoustic_flops[bucket] = (
+        self._acoustic_flops[(bucket, precision)] = (
             self.program_registry.card(name) or {}
         ).get("flops")
 
@@ -791,9 +883,22 @@ class SynthesisEngine:
             return []
         styles = self._resolve_styles(requests)
         bucket = self.cover(requests)
+        # one precision per coalesced dispatch: a tier's router stamps
+        # every request it owns with its precision, so mixed batches
+        # only arise from direct engine use — the first tagged request
+        # wins and the batch dispatches at that tier
+        prec = next(
+            (r.precision for r in requests if r.precision),
+            self.default_precision,
+        )
+        if prec not in self._params_by_precision:
+            raise ValueError(
+                f"request precision {prec!r} not in this engine's axis "
+                f"{self.precisions}"
+            )
         self._ensure_program(
-            "acoustic", bucket, self._acoustic,
-            lambda: self._compile_acoustic(bucket),
+            "acoustic", (bucket, prec), self._acoustic,
+            lambda: self._compile_acoustic(bucket, prec),
         )
         if self.vocoder is not None:
             self._ensure_program(
@@ -851,9 +956,9 @@ class SynthesisEngine:
                                                              fill=1)),
             }
             dev = self._transfer(arrays)
-            out = self._acoustic[bucket](
-                self.variables, dev["speakers"], dev["texts"],
-                dev["src_lens"], dev["gammas"], dev["betas"],
+            out = self._acoustic[(bucket, prec)](
+                self._params_by_precision[prec], dev["speakers"],
+                dev["texts"], dev["src_lens"], dev["gammas"], dev["betas"],
                 dev["p_control"], dev["e_control"], dev["d_control"],
             )
             mel_out = out["mel_postnet"]  # [b, t, n_mels] device array
@@ -907,20 +1012,26 @@ class SynthesisEngine:
         self._dispatches.inc()
         self._request_rows.inc(n)
         dur = time.monotonic() - t_dispatch
+        # the f32 label stays the historical bucket spelling; other
+        # precisions suffix it, so per-tier latency separates without
+        # changing any existing series
+        dispatch_label = bucket_label(bucket)
+        if prec != "f32":
+            dispatch_label = f"{dispatch_label}@{prec}"
         self.registry.histogram(
             "serve_dispatch_seconds",
-            labels={"bucket": bucket_label(bucket)},
+            labels={"bucket": dispatch_label},
             help="wall time of one padded device dispatch, per lattice bucket",
         ).observe(dur)
         # achieved FLOP/s: the cards' static FLOPs over the measured wall
         # time — a hardware-utilization number for the padded program as
         # executed (row occupancy is serve_batch_occupancy_total's job)
-        flops = self._dispatch_flops(bucket)
+        flops = self._dispatch_flops(bucket, prec)
         if flops is not None and dur > 0:
             self.registry.histogram(
                 "serve_achieved_flops_per_sec",
                 edges=FLOPS_PER_SEC_BUCKETS,
-                labels={"bucket": bucket_label(bucket)},
+                labels={"bucket": dispatch_label},
                 help="ProgramCard FLOPs / measured dispatch seconds "
                      "(MFU-style achieved rate, per lattice bucket)",
             ).observe(flops / dur)

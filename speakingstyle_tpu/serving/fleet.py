@@ -161,11 +161,16 @@ class FleetRouter:
         # plan threaded in by cli/serve.py / bench --chaos; consumes the
         # replica_raise@N / replica_hang@N kinds (N = router-global
         # dispatch counter, 1-based). None = no injection.
+        tier: Optional[str] = None,  # quality-tier name when this router
+        # serves one tier of a TierRouter ("teacher-f32", "student-int8",
+        # ...); stamped onto every result as SynthesisResult.tier.
+        # None = untiered (the historical single-router deployment).
     ):
         serve = cfg.serve
         fleet = serve.fleet
         self.cfg = cfg
         self.fleet = fleet
+        self.tier = tier
         self.engine_factory = engine_factory
         self.registry = registry if registry is not None else MetricsRegistry()
         self.events = events
@@ -842,6 +847,8 @@ class FleetRouter:
                     self._set_breaker_gauge(rep)
             for p, r in zip(batch, results):
                 r.replica = rep.index
+                if self.tier is not None:
+                    r.tier = self.tier
                 self._latency_hist.observe(now - p.request.arrival)
                 if now > p.slo_deadline:
                     self.registry.counter(

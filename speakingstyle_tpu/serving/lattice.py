@@ -55,7 +55,10 @@ class BucketLattice:
         batch_buckets: Sequence[int],
         src_buckets: Sequence[int],
         mel_buckets: Sequence[int],
+        precisions: Sequence[str] = ("f32",),
     ):
+        from speakingstyle_tpu.parallel.registry import PRECISIONS
+
         for name, vals in (("batch", batch_buckets), ("src", src_buckets),
                            ("mel", mel_buckets)):
             if not vals or sorted(vals) != list(vals) or min(vals) <= 0:
@@ -63,13 +66,30 @@ class BucketLattice:
                     f"{name} buckets must be non-empty ascending positive, "
                     f"got {list(vals)}"
                 )
+        if not precisions or any(p not in PRECISIONS for p in precisions) \
+                or len(set(precisions)) != len(precisions):
+            raise ValueError(
+                f"precisions must be a non-empty unique subset of "
+                f"{PRECISIONS}, got {list(precisions)}"
+            )
         self.batch_buckets = list(batch_buckets)
         self.src_buckets = list(src_buckets)
         self.mel_buckets = list(mel_buckets)
+        # the precision axis: geometry points() stay precision-free (a
+        # bucket is a shape), but the lattice's SIZE — how many acoustic
+        # programs a ready engine holds — multiplies by the tiers
+        self.precisions = list(precisions)
 
     @classmethod
     def from_config(cls, serve: ServeConfig) -> "BucketLattice":
-        return cls(serve.batch_buckets, serve.src_buckets, serve.mel_buckets)
+        tiers = getattr(serve, "tiers", None)
+        precisions = (
+            tuple(tiers.precisions)
+            if tiers is not None and tiers.enabled
+            else ("f32",)
+        )
+        return cls(serve.batch_buckets, serve.src_buckets,
+                   serve.mel_buckets, precisions=precisions)
 
     @property
     def max_batch(self) -> int:
@@ -95,6 +115,12 @@ class BucketLattice:
         return sorted(pts, key=lambda p: (p.volume, p))
 
     def __len__(self) -> int:
+        return (len(self.batch_buckets) * len(self.src_buckets)
+                * len(self.mel_buckets) * len(self.precisions))
+
+    def geometry_count(self) -> int:
+        """Shape points only (``len(points())``) — ``len(self)`` is this
+        times the precision-axis length."""
         return (len(self.batch_buckets) * len(self.src_buckets)
                 * len(self.mel_buckets))
 

@@ -774,6 +774,9 @@ class SynthesisServer:
                 version = outer.model_version()
                 if version is not None:
                     extra_hdr["X-Model-Version"] = version
+                tier = outer.model_tier(result)
+                if tier is not None:
+                    extra_hdr["X-Model-Tier"] = tier
                 # cluster mode: which replica process actually served
                 # this — joins the req_id trail in the JSONL events
                 served_by = getattr(result, "served_by", None)
@@ -801,6 +804,8 @@ class SynthesisServer:
                     self.send_header("X-Style-Degraded", "1")
                 if version is not None:
                     self.send_header("X-Model-Version", version)
+                if tier is not None:
+                    self.send_header("X-Model-Tier", tier)
                 if served_by:
                     self.send_header("X-Served-By", served_by)
                 self.end_headers()
@@ -826,6 +831,9 @@ class SynthesisServer:
                 version = outer.model_version()
                 if version is not None:
                     self.send_header("X-Model-Version", version)
+                tier = outer.model_tier(result)
+                if tier is not None:
+                    self.send_header("X-Model-Tier", tier)
                 self.end_headers()
                 try:
                     with outer.stream_scope():
@@ -928,6 +936,9 @@ class SynthesisServer:
                 version = outer.model_version()
                 if version is not None:
                     self.send_header("X-Model-Version", version)
+                tier = outer.model_tier()
+                if tier is not None:
+                    self.send_header("X-Model-Tier", tier)
                 self.end_headers()
                 try:
                     with outer.stream_scope():
@@ -1149,6 +1160,32 @@ class SynthesisServer:
         info = self.model_info()
         return info.get("version") if info else None
 
+    def model_tier(self, result=None) -> Optional[str]:
+        """Which quality tier produced (or would produce) a response —
+        the ``X-Model-Tier`` header. A result stamped by a TierRouter
+        names its actual tier; otherwise the process's default tier:
+        the TierRouter's fallback, or ``teacher-<precision>`` from the
+        lattice's leading precision (same-bucket programs at different
+        precisions are indistinguishable without this). A plain
+        single-precision f32 process has nothing to disambiguate, so it
+        gets None and its headers/healthz stay byte-identical to the
+        pre-tier surface."""
+        tier = getattr(result, "tier", None) if result is not None else None
+        if tier:
+            return tier
+        if self.router is not None:
+            if hasattr(self.router, "tier_for"):
+                return self.router.tier_for(None)
+            lattice = self.router.lattice
+        elif self.engine is not None:
+            lattice = self.engine.lattice
+        else:
+            return None
+        precisions = tuple(getattr(lattice, "precisions", None) or ("f32",))
+        if precisions == ("f32",):
+            return None
+        return f"teacher-{precisions[0]}"
+
     def refresh_process_gauges(self) -> None:
         """Sample process RSS + uptime into the registry (called at
         scrape so /metrics always exports a current value)."""
@@ -1236,7 +1273,27 @@ class SynthesisServer:
         # startup by cli/serve.py)
         model = self.model_info()
         if model:
-            out["model"] = model
+            out["model"] = dict(model)
+            # same-bucket programs at different precisions serve under
+            # one version string — the tier disambiguates which quality
+            # level this process answers with by default
+            tier = self.model_tier()
+            if tier is not None:
+                out["model"]["tier"] = tier
+        # tiered routing (serving/tiers.py): the effective class->tier
+        # map with gate fallbacks applied, plus each gated tier's
+        # golden-set verdict — the canary-as-quality-door paper trail
+        if self.router is not None and hasattr(self.router, "routing_table"):
+            out["tiers"] = {
+                "default": self.router.default_tier,
+                "routing": self.router.routing_table(),
+                "gates": {
+                    name: (g.as_dict() if (g := self.router.gate_result(name))
+                           is not None else {"shipped": True,
+                                             "detail": "ungated anchor"})
+                    for name in self.router.tiers()
+                },
+            }
         # present only when an Autoscaler is driving scale_to(): the
         # policy's last target plus its decision tally by reason
         if "serve_autoscale_target" in gauges:

@@ -1,0 +1,438 @@
+"""Teacher->student distillation of the acoustic model (ROADMAP item 2b).
+
+The fast tier's weights: a student FastSpeech2 with HALVED encoder /
+decoder depth and width (the existing ModelConfig knobs — no new model
+code) trained to match the frozen teacher's outputs. Distillation here
+is data-free: both models free-run/teacher-force over seeded synthetic
+phoneme batches, so the student learns the teacher's *function* —
+including its duration/pitch/energy predictors — without touching the
+preprocessed dataset (RedApt's faster-and-smaller regime, PAPERS.md,
+driven purely by the teacher's mels as targets).
+
+One jitted step (through ``jit_program``, the sanctioned constructor):
+
+  1. the frozen teacher free-runs the batch (``stop_gradient``-frozen by
+     construction — its variables enter as a non-differentiated arg),
+     emitting mel/duration/pitch/energy targets;
+  2. the student runs TEACHER-FORCED on the teacher's durations (so both
+     mels align frame-for-frame) and ``fastspeech2_loss`` scores it
+     against the teacher's postnet mel — the same masked L1/MSE stack
+     training uses, with the dataset targets swapped for teacher
+     predictions.
+
+FiLM conditioning is sampled per batch (``style_scale``-scaled gaussian
+gamma/beta vectors): the student learns the teacher's response across
+the conditioning space it will serve behind the shared StyleService,
+without running any reference encoder in the loop.
+
+The resilience stack rides along unchanged: ``SPEAKINGSTYLE_FAULTS``
+(``nan_grads`` poisons the FiLM inputs — the analogue of poisoning mel
+targets, which a data-free loop doesn't have; ``sigterm`` delivers a
+real signal), the NaN sentinel + RollbackGuard roll back to the last
+good student checkpoint, and checkpoints land under
+``<ckpt_path>/student`` through the manifest-verified CheckpointManager
+— the student IS a second model version the PR-13 rollout/tier gates
+can verify-and-build like any other.
+"""
+
+import os
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from speakingstyle_tpu.configs.config import Config
+
+__all__ = [
+    "STUDENT_SUBDIR",
+    "make_distill_batch",
+    "make_distill_step",
+    "run_distillation",
+    "student_config",
+]
+
+# where the student checkpoints live relative to train.path.ckpt_path —
+# a sibling model version, not a new step range of the teacher's
+STUDENT_SUBDIR = "student"
+
+
+def student_config(cfg: Config) -> Config:
+    """The student's Config: encoder/decoder DEPTH (layers) and WIDTH
+    (FFN filter, postnet dim/layers) halved, floored at 1. The model dim
+    (``encoder_hidden``/``decoder_hidden``) and the variance-predictor
+    filter stay: FiLM broadcasts ``[B, 1, d_model]`` gamma/beta straight
+    onto the residual stream AND the predictors' conv streams, so those
+    widths ARE the style interface — the student must keep them to
+    consume the same conditioning vectors the teacher does (and to share
+    one StyleService at serve time). The FFN inner width carries ~4x the
+    hidden dim's parameters per layer, so halving depth + FFN + postnet
+    still cuts the FLOP bill roughly in half without severing that
+    interface."""
+    import dataclasses
+
+    tf = cfg.model.transformer
+
+    def half(n: int) -> int:
+        return max(1, n // 2)
+
+    student_tf = dataclasses.replace(
+        tf,
+        encoder_layer=half(tf.encoder_layer),
+        decoder_layer=half(tf.decoder_layer),
+        conv_filter_size=half(tf.conv_filter_size),
+    )
+    model = dataclasses.replace(
+        cfg.model,
+        transformer=student_tf,
+        postnet_embedding_dim=half(cfg.model.postnet_embedding_dim),
+        # floor 2: a 1-layer postnet degenerates to one mel->mel conv,
+        # which is WIDER (80->80 channels) than two narrow layers
+        postnet_layers=max(2, cfg.model.postnet_layers // 2),
+    )
+    return dataclasses.replace(cfg, model=model)
+
+
+def make_distill_batch(cfg: Config, rng: np.random.Generator,
+                       batch_size: int, src_len: int,
+                       style_scale: float = 0.1) -> Dict[str, np.ndarray]:
+    """One seeded synthetic batch: random phoneme ids, full-length rows,
+    and gaussian FiLM vectors. Shapes are constant across steps, so the
+    whole run compiles exactly one step program."""
+    d = cfg.model.reference_encoder.encoder_hidden
+    return {
+        "speakers": np.zeros((batch_size,), np.int32),
+        "texts": rng.integers(
+            1, 300, (batch_size, src_len)).astype(np.int32),
+        "src_lens": np.full((batch_size,), src_len, np.int32),
+        "gammas": (style_scale * rng.standard_normal(
+            (batch_size, 1, d))).astype(np.float32),
+        "betas": (style_scale * rng.standard_normal(
+            (batch_size, 1, d))).astype(np.float32),
+    }
+
+
+def poison_distill_batch(arrays: Dict) -> Dict:
+    """The ``nan_grads`` drill for the data-free loop: NaN the FiLM
+    inputs (there are no mel targets to poison — the teacher computes
+    them in-step), driving every loss and gradient non-finite through
+    the real forward/backward path."""
+    import jax.numpy as jnp
+
+    out = dict(arrays)
+    out["gammas"] = jnp.asarray(out["gammas"]) * jnp.float32(jnp.nan)
+    return out
+
+
+def make_distill_step(student_model, teacher_model, teacher_variables,
+                      tx, cfg: Config, max_mel_len: int):
+    """jitted ``fn(state, arrays, rng) -> (state, losses)``.
+
+    The teacher forward runs INSIDE the step (frozen: its variables are
+    closed over, never differentiated), so teacher targets never round-
+    trip through the host and the whole distill iteration is one XLA
+    program. Losses carry the ``_finite`` sentinel when
+    ``train.resilience.nan_sentinel`` is on, read at log boundaries
+    exactly like the main trainer's.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from speakingstyle_tpu.models.loss import fastspeech2_loss
+    from speakingstyle_tpu.parallel.registry import jit_program
+    from speakingstyle_tpu.training import resilience
+
+    lambda_f = cfg.train.loss.lambda_f
+    p_level = cfg.preprocess.preprocessing.pitch.feature
+    e_level = cfg.preprocess.preprocessing.energy.feature
+    nan_sentinel = cfg.train.resilience.nan_sentinel
+    use_style = cfg.model.use_reference_encoder
+
+    def step_fn(state, arrays: Dict, rng):
+        rng = jax.random.fold_in(rng, state.step)
+        gammas = arrays["gammas"] if use_style else None
+        betas = arrays["betas"] if use_style else None
+        t_out = teacher_model.apply(
+            teacher_variables,
+            speakers=arrays["speakers"],
+            texts=arrays["texts"],
+            src_lens=arrays["src_lens"],
+            mels=None,
+            mel_lens=None,
+            max_mel_len=max_mel_len,
+            gammas=gammas,
+            betas=betas,
+            deterministic=True,
+        )
+        t_out = jax.lax.stop_gradient(t_out)
+
+        def loss_fn(params):
+            s_out, updates = student_model.apply(
+                {"params": params, "batch_stats": state.batch_stats},
+                speakers=arrays["speakers"],
+                texts=arrays["texts"],
+                src_lens=arrays["src_lens"],
+                mels=None,
+                mel_lens=t_out["mel_lens"],
+                max_mel_len=max_mel_len,
+                # teacher-forced on the TEACHER's predictions: the
+                # student's mel aligns frame-for-frame with its target,
+                # and its variance predictors regress onto the teacher's
+                p_targets=t_out["pitch_prediction"],
+                e_targets=t_out["energy_prediction"],
+                d_targets=t_out["durations"],
+                gammas=gammas,
+                betas=betas,
+                deterministic=False,
+                rngs={"dropout": rng},
+                mutable=["batch_stats"],
+            )
+            losses = fastspeech2_loss(
+                s_out,
+                t_out["mel_postnet"],
+                t_out["pitch_prediction"],
+                t_out["energy_prediction"],
+                t_out["durations"],
+                params,
+                lambda_f=lambda_f,
+                pitch_feature_level=p_level,
+                energy_feature_level=e_level,
+            )
+            return losses["total_loss"], (losses, updates["batch_stats"])
+
+        (_, (losses, batch_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(state.params)
+        if nan_sentinel:
+            losses = dict(losses)
+            losses["_finite"] = resilience.all_finite(losses, grads)
+        import optax
+
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=opt_state,
+        )
+        return new_state, losses
+
+    return jit_program(step_fn, donate_argnums=(0,))
+
+
+def run_distillation(
+    cfg: Config,
+    teacher_variables: Optional[Dict] = None,
+    max_steps: Optional[int] = None,
+    batch_size: int = 8,
+    src_len: Optional[int] = None,
+    log: bool = True,
+    registry=None,
+    ckpt_dir: Optional[str] = None,
+) -> Tuple[object, Config]:
+    """The distillation loop; returns ``(student_state, student_cfg)``.
+
+    ``teacher_variables=None`` restores the latest teacher checkpoint
+    from ``train.path.ckpt_path`` (manifest-verified), falling back to a
+    seeded fresh init when none exists (the smoke/drill mode — the
+    mechanics are identical, only the teacher is untrained). Student
+    checkpoints land under ``ckpt_dir`` (default
+    ``<ckpt_path>/student``) with per-leaf manifests, so the student is
+    restorable as a second model version by the same strict path the
+    rollout verify gate uses.
+    """
+    import jax
+
+    from speakingstyle_tpu import obs
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.parallel.registry import ProgramRegistry
+    from speakingstyle_tpu.training import faults, resilience
+    from speakingstyle_tpu.training.checkpoint import CheckpointManager
+    from speakingstyle_tpu.training.optim import make_optimizer
+    from speakingstyle_tpu.training.state import TrainState
+    from speakingstyle_tpu.training.trainer import (
+        TrainLogger,
+        public_losses,
+    )
+
+    res = cfg.train.resilience
+    steps_cfg = cfg.train.step
+    total_step = (
+        max_steps if max_steps is not None else steps_cfg.total_step
+    )
+    plan = faults.FaultPlan.from_env()
+    registry = registry if registry is not None else obs.get_registry()
+    # same choke-point discipline as run_training: wires the persistent
+    # cache before the first compile and counts distill compiles
+    ProgramRegistry(
+        registry,
+        cache_dir=cfg.train.obs.compilation_cache_dir or None,
+        counter_name="train_compiles_total",
+        prefix="train",
+    )
+
+    rng = jax.random.PRNGKey(cfg.train.seed)
+    teacher_model = build_model(cfg)
+    if teacher_variables is None:
+        fresh = init_variables(teacher_model, cfg, rng)
+        try:
+            teacher_ckpt = CheckpointManager(
+                cfg.train.path.ckpt_path, registry=registry
+            )
+            t_state = teacher_ckpt.restore(
+                TrainState.create(fresh, make_optimizer(cfg.train))
+            )
+            teacher_variables = {
+                "params": t_state.params,
+                "batch_stats": t_state.batch_stats,
+            }
+            teacher_ckpt.close()
+        except FileNotFoundError:
+            print(
+                "warning: no teacher checkpoint under "
+                f"{cfg.train.path.ckpt_path}; distilling against a "
+                "seeded fresh teacher (smoke mode)"
+            )
+            teacher_variables = fresh
+
+    s_cfg = student_config(cfg)
+    student_model = build_model(s_cfg)
+
+    def fresh_student_variables():
+        """Seeded student init WITH the teacher's reference encoder
+        grafted in: the distill loop conditions on sampled FiLM vectors,
+        so the student's own style encoder receives zero gradient and
+        would serve untrained garbage. The encoder config is
+        deliberately un-halved (same d_model), so the teacher's params
+        drop in — teacher and student then share one style front-end,
+        and a style vector encoded once serves both tiers."""
+        sv = init_variables(
+            student_model, s_cfg, jax.random.PRNGKey(cfg.train.seed + 2)
+        )
+        if cfg.model.use_reference_encoder:
+            t_ref = teacher_variables["params"].get("reference_encoder")
+            if t_ref is not None:
+                sp = dict(sv["params"])
+                # COPY, never alias: the jitted step donates the student
+                # state, and donated teacher buffers would be deleted
+                # out from under the caller's teacher_variables
+                sp["reference_encoder"] = jax.tree_util.tree_map(
+                    lambda x: np.array(x), t_ref
+                )
+                sv = dict(sv)
+                sv["params"] = sp
+        return sv
+
+    tx = make_optimizer(s_cfg.train)
+    state = TrainState.create(fresh_student_variables(), tx)
+
+    src = src_len if src_len is not None else min(
+        cfg.serve.src_buckets[0], 12
+    )
+    t_mel = min(
+        src * cfg.serve.frames_per_phoneme, cfg.model.max_seq_len
+    )
+    distill_step = make_distill_step(
+        student_model, teacher_model, teacher_variables, tx, cfg, t_mel
+    )
+
+    ckpt = CheckpointManager(
+        ckpt_dir or os.path.join(cfg.train.path.ckpt_path, STUDENT_SUBDIR),
+        max_to_keep=res.max_to_keep or None,
+        async_save=res.async_checkpointing,
+        keep_best=res.keep_best,
+        fault_plan=plan,
+        registry=registry,
+    )
+    guard = resilience.RollbackGuard(res.max_rollbacks)
+    abstract_template = state.abstract()
+    logger = None
+    if log:
+        logger = TrainLogger(
+            cfg.train.path.log_path, registry=registry
+        )
+        logger.event(
+            "distill_start", total_step=total_step, batch_size=batch_size,
+            src_len=src, max_mel_len=t_mel, teacher_subdir="",
+            student_subdir=STUDENT_SUBDIR,
+        )
+    steps_ctr = registry.counter(
+        "distill_steps_total", help="student optimizer steps run"
+    )
+    rollback_ctr = registry.counter(
+        "train_rollbacks_total", help="NaN-sentinel rollbacks taken"
+    )
+    step_hist = registry.histogram(
+        "distill_step_seconds", help="per-step wall time of the distill step"
+    )
+
+    batch_rng = np.random.default_rng(cfg.train.seed + 3)
+    step_rng = jax.random.PRNGKey(cfg.train.seed + 4)
+    step = int(state.step)
+    last_loss: Optional[float] = None
+    shutdown = resilience.GracefulShutdown()
+    try:
+        with shutdown:
+            while step < total_step and not shutdown.requested:
+                arrays = make_distill_batch(cfg, batch_rng, batch_size, src)
+                if plan.fire("nan_grads", step + 1):
+                    arrays = poison_distill_batch(arrays)
+                    if logger:
+                        logger.note(f"[fault] nan_grads fired at step "
+                                    f"{step + 1} (FiLM inputs poisoned)")
+                        logger.event("fault_fire", kind="nan_grads",
+                                     step=step + 1)
+                t0 = time.perf_counter()
+                state, losses = distill_step(state, arrays, step_rng)  # jaxlint: disable=JL006
+                step += 1
+                steps_ctr.inc()
+                step_hist.observe(time.perf_counter() - t0)
+                if plan.fire("sigterm", step):
+                    if logger:
+                        logger.event("fault_fire", kind="sigterm", step=step)
+                    faults.deliver_sigterm()
+                if step % steps_cfg.log_step == 0 or step >= total_step:
+                    jax.block_until_ready(losses["total_loss"])
+                    if "_finite" in losses and not bool(losses["_finite"]):
+                        n = guard.trip(step)  # raises past max_rollbacks
+                        ckpt.wait()
+                        good = ckpt.latest_step()
+                        rollback_ctr.inc()
+                        if logger:
+                            logger.note(
+                                f"[resilience] non-finite loss/grads at step "
+                                f"{step}; rollback {n}/{res.max_rollbacks} "
+                                f"to step {good}"
+                            )
+                            logger.event("rollback", step=step, rollback_n=n,
+                                         restore_step=good)
+                        if good is not None:
+                            state = ckpt.restore(abstract_template, step=good)
+                        else:
+                            # no good checkpoint yet: deterministic
+                            # re-init (same seed, same graft)
+                            state = TrainState.create(
+                                fresh_student_variables(), tx
+                            )
+                        step = int(state.step)  # jaxlint: disable=JL004
+                        continue
+                    guard.ok()
+                    last_loss = float(losses["total_loss"])
+                    if logger:
+                        logger.log(
+                            step,
+                            {k: float(v)
+                             for k, v in public_losses(losses).items()},
+                            prefix="distill",
+                        )
+                if step % steps_cfg.save_step == 0:
+                    ckpt.save(step, state, val_loss=last_loss)
+    finally:
+        # the student checkpoint is the artifact: always flush a final
+        # manifest-verified save (preemption included), like run_training
+        ckpt.save(step, state, val_loss=last_loss, block=True)
+        if logger:
+            logger.event("distill_end", step=step, loss=last_loss)
+            logger.close()
+        ckpt.close()
+    return state, s_cfg

@@ -1308,6 +1308,72 @@ def test_jl024_tree_baseline_is_zero():
 
 
 # ---------------------------------------------------------------------------
+# JL025 — weight-tree precision casts outside the sanctioned helper
+# ---------------------------------------------------------------------------
+
+
+def test_jl025_positive_each_cast_shape():
+    src = """
+        import jax
+        import jax.numpy as jnp
+
+        def shrink(variables, state, teacher_variables):
+            a = variables.astype(jnp.bfloat16)
+            b = jnp.float32(state.params)
+            c = jax.tree_util.tree_map(
+                lambda x: x.astype(jnp.bfloat16), teacher_variables)
+            return a, b, c
+    """
+    found = [
+        f for f in linter.lint_source(textwrap.dedent(src), _SERVING_PATH)
+        if f.rule == "JL025"
+    ]
+    assert len(found) == 3
+    assert all("weight-tree cast" in f.detail for f in found)
+
+
+def test_jl025_negative_registry_is_sanctioned():
+    # the ONE place weight casts are allowed: the cast_params /
+    # dequant_params choke point itself
+    src = """
+        import jax.numpy as jnp
+
+        def cast_params(variables, precision):
+            return variables.astype(jnp.bfloat16)
+    """
+    assert "JL025" not in _codes(
+        src, path="speakingstyle_tpu/parallel/registry.py"
+    )
+
+
+def test_jl025_negative_activation_and_nonweight_casts():
+    # activations, mels, and non-weight trees cast freely — the rule
+    # keys on params/variables naming, not on astype itself
+    assert "JL025" not in _codes("""
+        import jax
+        import jax.numpy as jnp
+
+        def fwd(x, mel, batch):
+            y = x.astype(jnp.bfloat16)
+            w = mel.astype(jnp.float32)
+            z = jax.tree_util.tree_map(lambda a: a.astype(jnp.float32), batch)
+            return y, w, z
+    """, path=_SERVING_PATH)
+
+
+def test_jl025_tree_baseline_is_zero():
+    """The precision-governance claim, structurally: every weight-tree
+    cast in the package flows through cast_params in
+    parallel/registry.py, so the registry cache key / ProgramCards /
+    tier gates see every precision that serves."""
+    findings = [f for f in linter.lint_paths() if f.rule == "JL025"]
+    assert findings == [], (
+        "JL025 must stay at zero tree findings — route weight-tree casts "
+        f"through cast_params: {[f.fingerprint for f in findings]}"
+    )
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -1451,6 +1517,11 @@ def test_every_rule_is_non_vacuous():
     # HTTP/socket call (derived from deadline budgets or
     # connect_timeout_s), and test_jl024_tree_baseline_is_zero pins the
     # unbounded-wire count at zero.
+    # JL025 is absent by construction as well: the precision lattice
+    # shipped with cast_params/dequant_params as the only weight-cast
+    # spellings in the tree (the rule exists to keep every future cast
+    # inside that choke point), and test_jl025_tree_baseline_is_zero
+    # pins the out-of-band count at zero.
     for code in ("JL001", "JL002", "JL003", "JL004", "JL005", "JL006",
                  "JL007", "JL008"):
         assert code in fired, f"{code} never fires on the real tree"
@@ -1501,6 +1572,8 @@ def test_cli_check_exits_zero_on_repo():
               "    return np.concatenate(out)\n"),
     ("JL024", "from http.client import HTTPConnection\n\ndef ping(host):\n"
               "    return HTTPConnection(host, 80)\n"),
+    ("JL025", "import jax.numpy as jnp\n\ndef shrink(variables):\n"
+              "    return variables.astype(jnp.bfloat16)\n"),
 ])
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
     # JL004 is scoped to training/ paths; JL007 to speakingstyle_tpu/;

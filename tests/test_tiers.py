@@ -1,0 +1,522 @@
+"""Quality-tiered serving: precision lattice, distillation, tier routing.
+
+Five layers, mirroring the tentpole:
+  1. registry — int8 per-channel quant/dequant round-trip error bounds
+     and bf16/f32 cast semantics (pure host-side, no engine);
+  2. engine — bf16/int8 lattice points compile ONCE at precompile and
+     dispatch with zero steady-state compiles (CompileMonitor on the
+     backend's monitoring bus, the same acceptance invariant the serve
+     smoke asserts);
+  3. routing — class->tier mapping through TierRouter, including the
+     canary-fail fallback to the teacher anchor (quality degrades in
+     budget, never in availability);
+  4. distillation — the data-free student smoke: loss falls against the
+     frozen teacher's mels, the student is strictly smaller, and
+     run_distillation lands a manifest-verified student checkpoint;
+  5. e2e — a mixed-tier fleet (two precisions of one engine behind two
+     FleetRouters) behind ONE TierRouter, zero compiles while serving.
+"""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+from speakingstyle_tpu.configs.config import (
+    Config,
+    ModelConfig,
+    ReferenceEncoderConfig,
+    ServeConfig,
+    StyleConfig,
+    TiersConfig,
+    TransformerConfig,
+    VarianceEmbeddingConfig,
+    VariancePredictorConfig,
+)
+from speakingstyle_tpu.parallel.registry import (
+    PRECISIONS,
+    cast_params,
+    dequant_params,
+)
+from speakingstyle_tpu.serving.engine import CompileMonitor, SynthesisRequest
+from speakingstyle_tpu.serving.lattice import BucketLattice
+from speakingstyle_tpu.serving.tiers import (
+    TierGateResult,
+    TierRouter,
+    parse_tier,
+    tier_gate,
+)
+
+# ---------------------------------------------------------------------------
+# registry: the sanctioned precision cast
+# ---------------------------------------------------------------------------
+
+
+def _weight_tree(rng):
+    return {
+        "dense": {
+            "kernel": rng.standard_normal((64, 32)).astype(np.float32),
+            "bias": rng.standard_normal((32,)).astype(np.float32),
+        },
+        "embed": rng.standard_normal((300, 16)).astype(np.float32),
+        "step": np.int32(7),
+    }
+
+
+def test_int8_roundtrip_error_is_bounded_per_channel():
+    """Per-channel symmetric quantization: |deq - orig| <= scale/2
+    elementwise (round-to-nearest), scale = per-channel amax/127."""
+    rng = np.random.default_rng(0)
+    tree = _weight_tree(rng)
+    q = cast_params(tree, "int8")
+    # matrix leaves became {int8_q, int8_scale} pairs ...
+    assert set(q["dense"]["kernel"].keys()) == {"int8_q", "int8_scale"}
+    assert q["dense"]["kernel"]["int8_q"].dtype == np.int8
+    # ... small/non-float leaves pass through untouched
+    assert q["dense"]["bias"] is tree["dense"]["bias"]
+    assert q["step"] == np.int32(7)
+    deq = dequant_params(q)
+    for orig, wide in ((tree["dense"]["kernel"], deq["dense"]["kernel"]),
+                       (tree["embed"], deq["embed"])):
+        amax = np.max(np.abs(orig), axis=tuple(range(orig.ndim - 1)))
+        bound = amax / 127.0 / 2.0 + 1e-7
+        err = np.max(np.abs(np.asarray(wide) - orig), axis=tuple(
+            range(orig.ndim - 1)))
+        assert np.all(err <= bound), (err, bound)
+
+
+def test_int8_zero_channel_and_idempotent_dequant():
+    tree = {"w": np.zeros((8, 4), np.float32)}
+    q = cast_params(tree, "int8")
+    # all-zero channel: scale clamps to 1.0 instead of dividing by zero
+    assert np.all(q["w"]["int8_scale"] == 1.0)
+    deq = dequant_params(q)
+    assert np.all(np.asarray(deq["w"]) == 0.0)
+    # identity on trees without int8 marker leaves
+    again = dequant_params(deq)
+    assert np.all(np.asarray(again["w"]) == 0.0)
+
+
+def test_bf16_and_f32_cast_semantics():
+    rng = np.random.default_rng(1)
+    tree = _weight_tree(rng)
+    assert cast_params(tree, "f32") is tree  # identity tier
+    b = cast_params(tree, "bf16")
+    import jax.numpy as jnp
+
+    assert b["dense"]["kernel"].dtype == jnp.bfloat16
+    assert b["step"] == np.int32(7)  # integer leaves pass through
+    # bf16 has ~8 mantissa bits: relative error under 1%
+    back = np.asarray(b["dense"]["kernel"], np.float32)
+    rel = np.abs(back - tree["dense"]["kernel"]) / (
+        np.abs(tree["dense"]["kernel"]) + 1e-6)
+    assert np.max(rel) < 0.01
+    with pytest.raises(ValueError):
+        cast_params(tree, "fp4")
+
+
+# ---------------------------------------------------------------------------
+# engine: precision lattice points compile once, dispatch compile-free
+# ---------------------------------------------------------------------------
+
+
+def _tiers_cfg(**tiers_kw):
+    tiers = dict(
+        enabled=True,
+        precisions=["f32", "bf16", "int8"],
+        class_tier={"interactive": "student-int8", "batch": "teacher-bf16"},
+        default_tier="teacher-f32",
+        tier_tolerance=0.5,
+        golden_set_size=2,
+    )
+    tiers.update(tiers_kw)
+    return Config(
+        model=ModelConfig(
+            transformer=TransformerConfig(
+                encoder_layer=1, decoder_layer=1, encoder_hidden=16,
+                decoder_hidden=16, conv_filter_size=16,
+                conv_kernel_size=(3, 1),
+            ),
+            reference_encoder=ReferenceEncoderConfig(
+                encoder_layer=1, encoder_head=2, encoder_hidden=16,
+                conv_layer=1, conv_filter_size=16,
+            ),
+            variance_predictor=VariancePredictorConfig(filter_size=16),
+            variance_embedding=VarianceEmbeddingConfig(n_bins=8),
+            postnet_embedding_dim=16, postnet_layers=2,
+            max_seq_len=48, compute_dtype="float32",
+        ),
+        serve=ServeConfig(
+            batch_buckets=[1, 2], src_buckets=[16], mel_buckets=[32],
+            frames_per_phoneme=2, max_wait_ms=20.0,
+            style=StyleConfig(ref_buckets=[32]),
+            tiers=TiersConfig(**tiers),
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def tier_engine():
+    """One precompiled engine over the full f32/bf16/int8 precision axis
+    (module-scoped: the AOT precompile is the expensive part)."""
+    import jax
+
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.models.hifigan import Generator
+    from speakingstyle_tpu.serving.engine import SynthesisEngine
+
+    cfg = _tiers_cfg()
+    model = build_model(cfg, n_position=49)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    bias = variables["params"]["variance_adaptor"]["duration_predictor"][
+        "linear_layer"]["bias"]
+    variables["params"]["variance_adaptor"]["duration_predictor"][
+        "linear_layer"]["bias"] = bias + 1.1
+    gen = Generator(
+        upsample_rates=(2, 2), upsample_kernel_sizes=(4, 4),
+        upsample_initial_channel=16, resblock_kernel_sizes=(3,),
+        resblock_dilation_sizes=((1,),),
+    )
+    gparams = gen.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8, 80), np.float32)
+    )["params"]
+    engine = SynthesisEngine(cfg, variables, vocoder=(gen, gparams),
+                             model=model)
+    engine.precompile()
+    return engine
+
+
+def _mkreq(i, L=10, T=20, precision=None, priority=None, rng=None):
+    rng = rng or np.random.default_rng(i)
+    return SynthesisRequest(
+        id=f"utt{i}",
+        sequence=rng.integers(1, 300, L).astype(np.int32),
+        ref_mel=rng.standard_normal((T, 80)).astype(np.float32),
+        precision=precision,
+        priority=priority,
+    )
+
+
+def test_precision_axis_precompiles_every_point(tier_engine):
+    # 2 batch buckets x 3 precisions acoustic + 2 vocoder (b, t) pairs
+    lattice = tier_engine.lattice
+    assert lattice.precisions == ["f32", "bf16", "int8"]
+    assert len(tier_engine._acoustic) == 6
+    assert tier_engine.compile_count == 8
+    # one param tree per precision, f32 is the identity tier
+    assert set(tier_engine._params_by_precision) == set(PRECISIONS)
+
+
+def test_every_precision_dispatches_with_zero_steady_compiles(tier_engine):
+    """The acceptance invariant on the precision axis: warm bf16/int8
+    dispatch recompiles nothing and the three tiers' mels stay close
+    (casting weights must not change the function materially)."""
+    mels = {}
+    for prec in PRECISIONS:
+        tier_engine.run([_mkreq(900, precision=prec)])  # warmup/transfer
+        with CompileMonitor() as mon:
+            r = tier_engine.run([_mkreq(7, precision=prec)])[0]
+        assert mon.count == 0, f"steady dispatch at {prec} compiled"
+        assert r.mel_len > 0 and np.all(np.isfinite(r.mel))
+        mels[prec] = r.mel
+    t = min(m.shape[0] for m in mels.values())
+    for prec in ("bf16", "int8"):
+        d = float(np.sqrt(np.mean(
+            (mels[prec][:t] - mels["f32"][:t]) ** 2)))
+        assert d < 0.5, f"{prec} drifted {d} RMS mel from f32"
+
+
+def test_unknown_precision_is_rejected(tier_engine):
+    with pytest.raises(ValueError, match="precision"):
+        tier_engine.run([_mkreq(8, precision="f64")])
+
+
+def test_program_cards_record_precision(tier_engine):
+    rows = tier_engine.program_registry.programs()
+    precs = {row.get("precision") for row in rows}
+    assert set(PRECISIONS) <= precs
+    names = [row.get("name", "") for row in rows]
+    # f32 names stay byte-identical to the pre-tier engine; other
+    # precisions are suffixed so /debug/programs tells them apart
+    assert any(n.startswith("acoustic:") and "@" not in n for n in names)
+    assert any(n.endswith("@bf16") for n in names)
+    assert any(n.endswith("@int8") for n in names)
+
+
+# ---------------------------------------------------------------------------
+# routing: class->tier with canary-fail fallback
+# ---------------------------------------------------------------------------
+
+
+class _StubRouter:
+    def __init__(self):
+        self.submitted = []
+
+    def submit(self, request):
+        self.submitted.append(request)
+        return request
+
+    def close(self, **kw):
+        pass
+
+
+def _gate(tier, mel_l2, tol=0.5):
+    return TierGateResult(
+        tier=tier, mel_l2=mel_l2, tolerance=tol, shipped=mel_l2 <= tol,
+        detail="test",
+    )
+
+
+def test_parse_tier_grammar():
+    spec = parse_tier("student-int8")
+    assert (spec.model, spec.precision) == ("student", "int8")
+    for bad in ("studnt-int8", "teacher", "teacher-fp64", "x-y-z"):
+        with pytest.raises(ValueError):
+            parse_tier(bad)
+
+
+def test_class_routing_and_canary_fail_fallback():
+    cfg = _tiers_cfg()
+    router = TierRouter(cfg)
+    anchor, bf16, student = _StubRouter(), _StubRouter(), _StubRouter()
+    router.add_tier("teacher-f32", anchor)  # ungated anchor
+    router.add_tier("teacher-bf16", bf16, gate=_gate("teacher-bf16", 0.1))
+    router.add_tier("student-int8", student,
+                    gate=_gate("student-int8", 0.2, tol=2.0))
+    assert router.tier_for("interactive") == "student-int8"
+    assert router.tier_for("batch") == "teacher-bf16"
+    assert router.tier_for(None) == "student-int8"  # default_class
+    assert router.tier_for("unmapped") == "teacher-f32"
+    # submit stamps the tier's precision and counts the dispatch
+    req = _mkreq(1, priority="interactive")
+    router.submit(req)
+    assert student.submitted == [req] and req.precision == "int8"
+    assert router.registry.counter(
+        "serve_tier_dispatch_total", labels={"tier": "student-int8"}
+    ).value == 1
+
+    # now the student's canary FAILS: its classes fall back to the
+    # anchor — the tier stays registered but leaves the routing table
+    failed = TierRouter(cfg)
+    failed.add_tier("teacher-f32", anchor)
+    failed.add_tier("teacher-bf16", bf16, gate=_gate("teacher-bf16", 0.1))
+    failed.add_tier("student-int8", student,
+                    gate=_gate("student-int8", 3.0, tol=2.0))
+    assert not failed.shipped("student-int8")
+    assert failed.tier_for("interactive") == "teacher-f32"
+    assert failed.routing_table()["interactive"] == "teacher-f32"
+    assert failed.routing_table()["batch"] == "teacher-bf16"
+    req = _mkreq(2, priority="interactive")
+    failed.submit(req)
+    assert anchor.submitted[-1] is req and req.precision == "f32"
+
+
+def test_tier_gate_ships_recasts_and_fails_broken_tier(tier_engine):
+    """The quality door on a REAL engine: the bf16/int8 recasts of the
+    same weights hold under tolerance; a deliberately broken candidate
+    (NaN weights) is refused with a non-finite verdict."""
+    import jax
+
+    from speakingstyle_tpu.serving.engine import SynthesisEngine
+
+    cfg = tier_engine.cfg
+    for tier in ("teacher-bf16", "teacher-int8"):
+        g = tier_gate(tier_engine, tier_engine, cfg, tier)
+        assert g.shipped, g.detail
+        assert g.mel_l2 <= g.tolerance
+
+    broken_vars = jax.tree_util.tree_map(
+        lambda x: (np.full_like(np.asarray(x), np.nan)
+                   if np.issubdtype(np.asarray(x).dtype, np.floating)
+                   else x),
+        tier_engine.variables,
+    )
+    broken = SynthesisEngine(
+        cfg, broken_vars, vocoder=tier_engine.vocoder,
+        lattice=BucketLattice([1, 2], [16], [32],
+                              precisions=("f32", "bf16")),
+        model=tier_engine.model,
+    )
+    g = tier_gate(broken, tier_engine, cfg, "teacher-bf16")
+    assert not g.shipped
+    assert g.mel_l2 == float("inf")
+
+
+# ---------------------------------------------------------------------------
+# distillation: the student smoke
+# ---------------------------------------------------------------------------
+
+
+def _distill_cfg(tmp_path):
+    """Tiers cfg + train paths into tmp and the LR ramp shortened
+    (train.loss.anneal_steps gates the init_lr->anneal_lr ramp; at the
+    10k default a 40-step smoke never leaves init_lr and the loss
+    barely moves)."""
+    cfg = _tiers_cfg()
+    return dataclasses.replace(
+        cfg,
+        train=dataclasses.replace(
+            cfg.train,
+            path=dataclasses.replace(
+                cfg.train.path,
+                ckpt_path=str(tmp_path / "ckpt"),
+                log_path=str(tmp_path / "log"),
+            ),
+            step=dataclasses.replace(
+                cfg.train.step, total_step=40, log_step=10, save_step=20,
+            ),
+            loss=dataclasses.replace(cfg.train.loss, anneal_steps=5),
+        ),
+    )
+
+
+def test_student_config_halves_depth_and_keeps_film_interface():
+    from speakingstyle_tpu.training.distill import student_config
+
+    cfg = _tiers_cfg()
+    big = dataclasses.replace(cfg, model=dataclasses.replace(
+        cfg.model,
+        transformer=dataclasses.replace(
+            cfg.model.transformer, encoder_layer=4, decoder_layer=4,
+            conv_filter_size=64),
+        postnet_layers=4,
+    ))
+    s = student_config(big)
+    assert s.model.transformer.encoder_layer == 2
+    assert s.model.transformer.decoder_layer == 2
+    assert s.model.transformer.conv_filter_size == 32
+    assert s.model.postnet_layers == 2
+    # the FiLM/style interface must survive halving: d_model, the ref
+    # encoder, and the variance-predictor filter are the conditioning
+    # surface shared with the teacher's StyleService
+    assert s.model.transformer.encoder_hidden == 16
+    assert s.model.reference_encoder == big.model.reference_encoder
+    assert s.model.variance_predictor == big.model.variance_predictor
+
+
+def test_distill_smoke_loss_falls_and_checkpoints(tmp_path):
+    """40 data-free steps against a frozen (biased) teacher: the loss
+    falls materially, the student is strictly smaller, its reference
+    encoder is the teacher's (grafted — it gets no gradient from the
+    FiLM-conditioned loop), and a manifest-verified checkpoint lands
+    under the student subdir as a second model version."""
+    import jax
+
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.training.distill import (
+        STUDENT_SUBDIR,
+        run_distillation,
+    )
+
+    cfg = _distill_cfg(tmp_path)
+    teacher_model = build_model(cfg)
+    t_vars = init_variables(teacher_model, cfg, jax.random.PRNGKey(0))
+    bias = t_vars["params"]["variance_adaptor"]["duration_predictor"][
+        "linear_layer"]["bias"]
+    t_vars["params"]["variance_adaptor"]["duration_predictor"][
+        "linear_layer"]["bias"] = bias + 1.1
+
+    from speakingstyle_tpu.obs import MetricsRegistry
+
+    registry = MetricsRegistry()
+    state, s_cfg = run_distillation(
+        cfg, teacher_variables=t_vars, max_steps=40, batch_size=4,
+        src_len=8, log=False, registry=registry,
+    )
+    assert int(state.step) == 40
+    assert registry.counter("distill_steps_total").value == 40
+
+    def count(params):
+        return sum(int(x.size) for x in jax.tree_util.tree_leaves(params))
+
+    assert count(state.params) < count(t_vars["params"])
+    # the grafted style front-end: byte-identical to the teacher's
+    t_ref = t_vars["params"]["reference_encoder"]
+    s_ref = state.params["reference_encoder"]
+    for a, b in zip(jax.tree_util.tree_leaves(t_ref),
+                    jax.tree_util.tree_leaves(s_ref)):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    ckpt_dir = os.path.join(cfg.train.path.ckpt_path, STUDENT_SUBDIR)
+    assert os.path.isdir(ckpt_dir) and os.listdir(ckpt_dir)
+
+    # loss falls: re-run the identical seeded loop step-by-step and
+    # compare the first logged loss against the last (run_distillation
+    # doesn't expose its loss trajectory; the step fn does)
+    from speakingstyle_tpu.training.distill import (
+        make_distill_batch,
+        make_distill_step,
+        student_config,
+    )
+    from speakingstyle_tpu.training.optim import make_optimizer
+    from speakingstyle_tpu.training.state import TrainState
+
+    s_cfg2 = student_config(cfg)
+    student_model = build_model(s_cfg2)
+    s_vars = init_variables(student_model, s_cfg2, jax.random.PRNGKey(9))
+    tx = make_optimizer(s_cfg2.train)
+    st = TrainState.create(s_vars, tx)
+    step = make_distill_step(student_model, teacher_model, t_vars, tx,
+                             cfg, max_mel_len=16)
+    rng = np.random.default_rng(0)
+    import jax as _jax
+
+    key = _jax.random.PRNGKey(0)
+    losses = []
+    for _ in range(40):
+        st, l = step(st, make_distill_batch(cfg, rng, 4, 8), key)
+        losses.append(float(l["total_loss"]))
+    assert np.isfinite(losses).all()
+    early, late = np.mean(losses[:5]), np.mean(losses[-5:])
+    assert late < 0.8 * early, (early, late)
+
+
+# ---------------------------------------------------------------------------
+# e2e: mixed-tier fleet behind one TierRouter
+# ---------------------------------------------------------------------------
+
+
+def test_mixed_tier_fleet_e2e_zero_compiles(tier_engine):
+    """Two precisions of one engine behind two FleetRouters behind ONE
+    TierRouter: classes route to their tiers, results come back stamped
+    with the producing tier, dispatch counters tally per tier, and the
+    whole mixed-serve phase performs zero XLA compiles."""
+    from speakingstyle_tpu.obs import MetricsRegistry
+    from speakingstyle_tpu.serving.fleet import FleetRouter
+
+    cfg = dataclasses.replace(tier_engine.cfg, serve=dataclasses.replace(
+        tier_engine.cfg.serve,
+        tiers=dataclasses.replace(
+            tier_engine.cfg.serve.tiers,
+            class_tier={"interactive": "teacher-bf16"},
+        ),
+    ))
+    registry = MetricsRegistry()
+    router = TierRouter(cfg, registry=registry)
+    for name, gate in (("teacher-f32", None),
+                       ("teacher-bf16", _gate("teacher-bf16", 0.1))):
+        fleet = FleetRouter(lambda reg: tier_engine, cfg, replicas=1,
+                            registry=registry, tier=name)
+        assert fleet.wait_ready(timeout=120, n=1)
+        router.add_tier(name, fleet, gate=gate)
+    # warmup transfers per tier, then the measured mixed phase
+    for prec in ("f32", "bf16"):
+        tier_engine.run([_mkreq(950, precision=prec)])
+    try:
+        with CompileMonitor() as mon:
+            results = []
+            for i in range(8):
+                prio = "interactive" if i % 2 == 0 else "batch"
+                fut = router.submit(_mkreq(100 + i, priority=prio))
+                results.append((prio, fut.result(timeout=60)))
+        assert mon.count == 0, "mixed-tier serving compiled"
+        for prio, r in results:
+            want = "teacher-bf16" if prio == "interactive" else "teacher-f32"
+            assert r.tier == want
+            assert r.mel_len > 0
+        for name, n in (("teacher-bf16", 4), ("teacher-f32", 4)):
+            assert registry.counter(
+                "serve_tier_dispatch_total", labels={"tier": name}
+            ).value == n
+    finally:
+        router.close()
