@@ -1768,6 +1768,16 @@ def _cluster_replica_child(rid: str, router_addr: str,
         SynthesisRequest,
     )
 
+    if os.environ.get("BENCH_TRACE_ARM") == "1":
+        # run_trace's armed phase: the replica records its own spans so
+        # the router can assemble the cross-process trace
+        from speakingstyle_tpu.obs.trace import (
+            configure_span_ring,
+            set_tracing_enabled,
+        )
+        configure_span_ring(8192, keep_traces=512)
+        set_tracing_enabled(True)
+
     cfg = _cluster_proxy_config(device_ms)
     serve = cfg.serve
     _mark(f"[{rid}] building model parts")
@@ -2078,6 +2088,332 @@ def run_cluster(duration: float = 3.0, clients: int = 16,
                 log.close()
             except OSError:
                 pass
+
+
+def run_trace(duration: float = 3.0, clients: int = 16,
+              device_ms: float = 20.0):
+    """Tracing drill: the cluster storm run twice — spans disarmed,
+    then armed fleet-wide — for an honest overhead ablation plus a
+    per-stage critical-path latency breakdown.
+
+    ONE 2-replica process cluster behind the ClusterRouter (same
+    CPU-proxy engine as run_cluster) serves a closed-loop storm in
+    which every client ALTERNATES traced and untraced requests — a
+    paired A/B, because separate clusters (baseline spread from
+    process placement) and alternating whole sub-phases (batching
+    regime drift) were both tried first and their ±10% p50 noise
+    swamped the sub-millisecond signal. Both arms sample the identical
+    queue, so the per-arm p50 difference is the marginal cost one
+    traced request pays. A traced request is the full plane: the
+    ``serve_request`` root span exactly as the HTTP front door creates
+    it, the context on the cluster wire (X-Trace-* headers), armed
+    replicas recording their side, tail-sample pinning. An untraced
+    request carries no context at all, so the delta prices the whole
+    feature, propagation included. From the recorded spans the router
+    ring + ``fetch_remote_spans`` are assembled per trace and the
+    critical path bucketed by stage (serve_queue / remote_dispatch /
+    replica_dispatch / ...), p50/p999 each. The overhead on TTFA p50
+    and the lost-request count carry hard gates in run_compare:
+    tracing that costs >2% or drops work does not ship. CPU-proxy
+    replicas: the percentiles measure the control plane + span
+    plumbing, never device throughput.
+    """
+    import collections
+
+    from speakingstyle_tpu.faults import FaultPlan
+    from speakingstyle_tpu.obs import MetricsRegistry
+    from speakingstyle_tpu.obs import trace as obstrace
+    from speakingstyle_tpu.obs.trace import Span, assemble_trace
+    from speakingstyle_tpu.serving.batcher import Overloaded
+    from speakingstyle_tpu.serving.cluster import ClusterRouter
+    from speakingstyle_tpu.serving.engine import SynthesisRequest
+
+    import numpy as np
+
+    label = "tiny-cpu-proxydev"
+    cfg = _cluster_proxy_config(device_ms)
+    serve = cfg.serve
+    here = os.path.dirname(os.path.abspath(__file__))
+    rng = np.random.default_rng(0)
+    max_len = min(serve.src_buckets[-1],
+                  serve.mel_buckets[-1] // serve.frames_per_phoneme)
+    n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+    max_ref = serve.style.ref_buckets[-1]
+    hot_refs = [
+        rng.standard_normal(
+            (int(rng.integers(8, max_ref + 1)), n_mels)
+        ).astype(np.float32)
+        for _ in range(8)
+    ]
+
+    def make_request(i: int, priority: str) -> SynthesisRequest:
+        L = int(rng.integers(max(4, max_len // 2), max_len + 1))
+        return SynthesisRequest(
+            id=f"trace{i}",
+            sequence=rng.integers(1, 300, L).astype(np.int32),
+            ref_mel=hot_refs[i % len(hot_refs)],
+            priority=priority,
+        )
+
+    def compile_counts(router):
+        out = {}
+        for rep in router._replicas:
+            eng = rep.engine
+            rid = getattr(eng, "replica_id", "")
+            if rid:
+                c = eng.compile_count
+                if c >= 0:
+                    out[rid] = c
+        return out
+
+    def run_phase(router, phase_s: float, seed: int):
+        stop_at = time.perf_counter() + phase_s
+        per = [dict(ok=0, shed=0, lost=0, errors=[])
+               for _ in range(clients)]
+        # per-client (untraced, traced) latency pair — the paired A/B
+        lats = [([], []) for _ in range(clients)]
+
+        diffs = [[] for _ in range(clients)]
+
+        def client(cid: int):
+            c, i = per[cid], 0
+            prev = None  # (index, traced, latency) of last success
+            while time.perf_counter() < stop_at:
+                # requests 2j and 2j+1 form a pair: same class,
+                # adjacent in time, one traced one not (which goes
+                # first flips with client parity, cancelling order
+                # bias) — the paired diff is the ablation signal
+                prio = ("interactive"
+                        if ((i // 2) + cid) % 2 == 0 else "batch")
+                traced = (cid + i) % 2 == 0
+                req = make_request(seed + cid * 1_000_000 + i, prio)
+                t0 = time.perf_counter()
+                try:
+                    if traced:
+                        # the root span every served request gets from
+                        # the HTTP front door; trace_id == req_id, so
+                        # the dumps answer /debug/trace/<req_id>
+                        with Span("serve_request", trace_id=req.id,
+                                  req_id=req.id, klass=prio) as sp:
+                            req.trace = sp.ctx
+                            router.submit(req).result(timeout=120)
+                    else:
+                        router.submit(req).result(timeout=120)
+                    c["ok"] += 1
+                    lat = time.perf_counter() - t0
+                    lats[cid][int(traced)].append(lat)
+                    if i % 2 == 1 and prev is not None \
+                            and prev[0] == i - 1:
+                        d = (lat - prev[2]) if traced else (prev[2] - lat)
+                        diffs[cid].append(d)  # traced minus untraced
+                    prev = (i, traced, lat)
+                except Overloaded:
+                    c["shed"] += 1
+                    prev = None
+                    time.sleep(0.002)
+                except Exception as e:
+                    c["lost"] += 1
+                    c["errors"].append(type(e).__name__)
+                    prev = None
+                i += 1
+
+        threads = [threading.Thread(target=client, args=(c,), daemon=True)
+                   for c in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        out = {k: sum(c[k] for c in per) for k in ("ok", "shed", "lost")}
+        out["errors"] = sorted({e for c in per for e in c["errors"]})
+        out["qps"] = out["ok"] / dt
+        out["lat_off"] = [v for g in lats for v in g[0]]
+        out["lat_on"] = [v for g in lats for v in g[1]]
+        out["diffs"] = [v for g in diffs for v in g]
+        return out
+
+    def pctl_ms(vals, q):
+        if not vals:
+            return None
+        return round(1e3 * float(np.percentile(vals, q)), 3)
+
+    logs = []
+
+    def spawn(rid, router_addr, extra):
+        log = open(os.path.join(here, f".bench_trace_{rid}.log"), "w")
+        logs.append(log)
+        # replicas spawn armed; they record spans only for requests
+        # whose wire envelope carries a trace context, which is what
+        # the off/on sub-phases toggle
+        return subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--cluster-replica-inner", "--rid", rid,
+             "--router", router_addr, "--device-ms", str(device_ms)],
+            stdout=log, stderr=log, cwd=here,
+            env={**os.environ, "JAX_PLATFORMS": "cpu",
+                 "BENCH_TRACE_ARM": "1"},
+        )
+
+    def blank():
+        return dict(ok=0, shed=0, lost=0, errors=[], lat_off=[],
+                    lat_on=[], diffs=[], qps_sum=0.0, phases=0)
+
+    def merge(acc, res):
+        for k in ("ok", "shed", "lost"):
+            acc[k] += res[k]
+        acc["errors"] = sorted(set(acc["errors"]) | set(res["errors"]))
+        acc["lat_off"].extend(res["lat_off"])
+        acc["lat_on"].extend(res["lat_on"])
+        acc["diffs"].extend(res["diffs"])
+        acc["qps_sum"] += res["qps"]
+        acc["phases"] += 1
+
+    def compile_delta(router, pre):
+        return sum(c - pre[rid]
+                   for rid, c in compile_counts(router).items()
+                   if rid in pre)
+
+    point = {
+        "metric": "serve_trace", "replicas": 2, "clients": clients,
+        "proxy_device_ms": device_ms, "model": label,
+        "unit": "ms closed-loop request latency (TTFA proxy on cpu)",
+    }
+    # one cluster, request-level pairing: machine drift and batching
+    # regimes hit both arms alike and cancel out of the ablation
+    prev_enabled = obstrace.tracing_enabled()
+    obstrace.configure_span_ring(16384, keep_traces=512)
+    obstrace.set_tracing_enabled(True)
+    res = blank()
+    _mark("spawning 2 armed replica processes")
+    router = ClusterRouter(spawn, cfg, replicas=2,
+                           registry=MetricsRegistry(),
+                           fault_plan=FaultPlan())
+    try:
+        if not router.wait_ready(timeout=600, n=2):
+            point["error"] = "replica processes never became ready"
+            print(json.dumps(point))
+            return point
+        # warm the mixed stream so span code is hot for the A/B
+        _mark("trace warmup")
+        run_phase(router, min(1.0, duration), 777)
+        pre = compile_counts(router)
+        _mark("trace storm: paired traced/untraced stream")
+        for k in range(2):
+            merge(res, run_phase(router, duration,
+                                 500_000_000 + k * 10_000_000))
+        res["compiles"] = compile_delta(router, pre)
+        # cross-process span harvest: the local ring (+ tail-kept
+        # traces) joined with every replica's dump
+        ring = obstrace.get_span_ring()
+        span_map = {}
+        for s in ring.spans():
+            sid = s.get("span_id")
+            if sid:
+                span_map.setdefault(sid, s)
+        for tid in ring.kept_trace_ids():
+            for s in ring.spans(tid):
+                sid = s.get("span_id")
+                if sid:
+                    span_map.setdefault(sid, s)
+        for s in router.fetch_remote_spans():
+            sid = s.get("span_id")
+            if sid:
+                span_map.setdefault(sid, s)
+        res["spans"] = list(span_map.values())
+        res["ring_evictions"] = ring.stats()["evictions"]
+    finally:
+        obstrace.set_tracing_enabled(prev_enabled)
+        try:
+            router.close()
+        except OSError:
+            pass
+        for log in logs:
+            try:
+                log.close()
+            except OSError:
+                pass
+    if "spans" not in res:
+        point.setdefault("error", "trace storm never completed")
+        print(json.dumps(point))
+        return point
+    res["qps"] = res["qps_sum"] / max(1, res["phases"])
+
+    # per-stage critical-path breakdown: assemble each fully-captured
+    # trace and bucket its critical-path spans
+    by_trace = collections.defaultdict(list)
+    for s in res["spans"]:
+        tid = s.get("trace_id")
+        if tid:
+            by_trace[tid].append(s)
+    stage = collections.defaultdict(list)
+    chains = collections.Counter()
+    assembled = cross_process = 0
+    for tid, group in sorted(by_trace.items()):
+        if assembled >= 512:
+            break
+        # a ring-evicted root means a partial trace: skip, the
+        # breakdown must only average complete critical paths
+        if not any(s.get("name") == "serve_request"
+                   and not s.get("parent_span_id") for s in group):
+            continue
+        view = assemble_trace(group, tid)
+        cp = view["critical_path"]
+        if not cp:
+            continue
+        assembled += 1
+        if any(s.get("name") == "replica_dispatch" for s in group):
+            cross_process += 1
+        chains[" > ".join(str(s.get("name")) for s in cp)] += 1
+        for s in cp:
+            if isinstance(s.get("duration_s"), (int, float)):
+                stage[str(s.get("name"))].append(float(s["duration_s"]))
+
+    off_p50 = pctl_ms(res["lat_off"], 50)
+    on_p50 = pctl_ms(res["lat_on"], 50)
+    off_p999 = pctl_ms(res["lat_off"], 99.9)
+    on_p999 = pctl_ms(res["lat_on"], 99.9)
+    # the gated statistic: median of the paired (traced - untraced)
+    # diffs over the untraced p50 — pooled-percentile deltas sit on
+    # the batching plateau edges and swing ±5% run to run, the paired
+    # median does not
+    med_diff_ms = pctl_ms(res["diffs"], 50)
+    point.update({
+        "untraced_ttfa_p50_ms": off_p50,
+        "untraced_ttfa_p999_ms": off_p999,
+        "traced_ttfa_p50_ms": on_p50,
+        "traced_ttfa_p999_ms": on_p999,
+        "qps": round(res["qps"], 2),
+        "paired_diff_p50_ms": med_diff_ms,
+        "paired_diffs": len(res["diffs"]),
+        "overhead_ttfa_p50_pct": (
+            round(100.0 * med_diff_ms / off_p50, 2)
+            if off_p50 and med_diff_ms is not None else None
+        ),
+        "overhead_ttfa_p999_pct": (
+            round(100.0 * (on_p999 - off_p999) / off_p999, 2)
+            if off_p999 else None
+        ),
+        "lost_requests": res["lost"],
+        "shed": res["shed"],
+        "errors": res["errors"],
+        "steady_compiles": res["compiles"],
+        "spans_recorded": len(res["spans"]),
+        "ring_evictions": res["ring_evictions"],
+        "traces_assembled": assembled,
+        "cross_process_traces": cross_process,
+        "critical_path_modal": (
+            chains.most_common(1)[0][0] if chains else None
+        ),
+        "stage_p50_ms": {k: pctl_ms(v, 50)
+                         for k, v in sorted(stage.items())},
+        "stage_p999_ms": {k: pctl_ms(v, 99.9)
+                          for k, v in sorted(stage.items())},
+        "stage_n": {k: len(v) for k, v in sorted(stage.items())},
+        **_lock_witness_stats(),
+    })
+    print(json.dumps(point))
+    return point
 
 
 def run_rollout(duration: float = 3.0, clients: int = 16,
@@ -3760,6 +4096,32 @@ def _absorb_record(rec, metrics):
         ):
             if isinstance(rec.get(src), (int, float)):
                 metrics[dst] = (float(rec[src]), "higher")
+    elif m == "serve_trace":
+        # the tracing-overhead ablation; the over-budget overhead and
+        # lost_requests carry hard gates in run_compare — tracing that
+        # slows the fleet >2% on TTFA p50 or drops a request does not
+        # ship at any threshold. The overhead itself hovers around
+        # zero where relative diffs are pure noise, so only the budget
+        # excess (0 when passing) is stored; the signed value stays in
+        # the emitted point
+        if isinstance(rec.get("overhead_ttfa_p50_pct"), (int, float)):
+            metrics["trace_overhead_over_budget_pct"] = (
+                max(0.0, float(rec["overhead_ttfa_p50_pct"]) - 2.0),
+                "lower")
+        for src, dst in (
+            ("traced_ttfa_p50_ms", "trace_on_ttfa_p50_ms"),
+            ("untraced_ttfa_p50_ms", "trace_off_ttfa_p50_ms"),
+            ("lost_requests", "trace_lost_requests"),
+            ("steady_compiles", "trace_steady_compiles"),
+        ):
+            if isinstance(rec.get(src), (int, float)):
+                metrics[dst] = (float(rec[src]), "lower")
+        for src, dst in (
+            ("qps", "trace_qps"),
+            ("cross_process_traces", "trace_cross_process_traces"),
+        ):
+            if isinstance(rec.get(src), (int, float)):
+                metrics[dst] = (float(rec[src]), "higher")
     elif m == "serve_rollout":
         # the live-upgrade drill; rollout_lost_requests carries the same
         # hard zero gate as chaos/traffic in run_compare — an upgrade
@@ -3958,6 +4320,22 @@ def run_compare(old_path, new_path=None, threshold=REGRESSION_THRESHOLD,
               "must drain-replace without dropping in-flight work",
               file=out)
         return 1
+    # and for the tracing drill: observability must be free-ish and
+    # safe — spans that slow the fleet beyond 2% on TTFA p50 or lose a
+    # request fail outright, independent of the old artifact
+    lost = new.get("trace_lost_requests")
+    if lost is not None and lost[0] > 0:
+        print(f"FAIL: tracing drill lost {int(lost[0])} request(s) in "
+              f"{os.path.basename(new_path)}; the trace plane must "
+              "never drop work", file=out)
+        return 1
+    ov = new.get("trace_overhead_over_budget_pct")
+    if ov is not None and ov[0] > 0:
+        print(f"FAIL: tracing overhead {ov[0] + 2.0:.2f}% on TTFA p50 "
+              f"in {os.path.basename(new_path)} exceeds the 2% budget; "
+              "span recording must stay off the request hot path",
+              file=out)
+        return 1
     # quality hard gate for the tier frontier: any SHIPPED tier whose
     # golden-set mel_l2 exceeds its tolerance is a quality outage, not
     # a 10%-threshold matter — the canary gate exists to keep such a
@@ -4099,6 +4477,7 @@ if __name__ == "__main__":
         run_mesh_serve(duration=dur)
         run_longform(duration=dur)
         run_tiers(duration=dur)
+        run_trace(duration=dur)
     elif "--tiers" in sys.argv:
         dur = (float(sys.argv[sys.argv.index("--duration") + 1])
                if "--duration" in sys.argv else 3.0)
@@ -4130,6 +4509,10 @@ if __name__ == "__main__":
         dur = (float(sys.argv[sys.argv.index("--duration") + 1])
                if "--duration" in sys.argv else 3.0)
         run_cluster(duration=dur)
+    elif "--trace" in sys.argv:
+        dur = (float(sys.argv[sys.argv.index("--duration") + 1])
+               if "--duration" in sys.argv else 3.0)
+        run_trace(duration=dur)
     elif "--fleet" in sys.argv:
         dur = (float(sys.argv[sys.argv.index("--duration") + 1])
                if "--duration" in sys.argv else 3.0)

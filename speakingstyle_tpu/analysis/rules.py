@@ -140,6 +140,17 @@ JL025  out-of-band weight-tree precision cast: ``<tree>.astype(...)``,
        tier canary gates all key on which precision a param tree
        carries, so an inline cast serves weights no gate approved and
        no card records. Tree baseline: zero.
+JL026  label-cardinality bomb at a metric registration site:
+       per-request identity (req_id, trace_id, span ids, idempotency
+       keys, raw text) flowing into a metric NAME or a label VALUE at
+       a ``registry.counter/gauge/histogram`` call under
+       speakingstyle_tpu/serving/ or obs/ — every distinct label value
+       mints a whole new time series, so a per-request label turns a
+       bounded /metrics page (and the fleet federation merge over it)
+       into an allocation that grows with traffic forever. Per-request
+       identity belongs on trace spans and JSONL events; metric labels
+       stay bounded (class, replica, reason, bucket).
+       Tree baseline: zero.
 """
 
 import ast
@@ -2616,6 +2627,124 @@ def rule_jl025(mod: ModuleInfo) -> Iterator[Finding]:
         )
 
 
+# ---------------------------------------------------------------------------
+# JL026 — label-cardinality bombs at metric registration sites
+# ---------------------------------------------------------------------------
+
+_JL026_METHODS = ("counter", "gauge", "histogram")
+
+# terminal identifiers (variable / attribute / subscript-key names) that
+# carry per-request identity — each distinct value mints a new series
+_JL026_PER_REQUEST = (
+    "req_id", "request_id", "trace_id", "span_id", "parent_span_id",
+    "idempotency_key", "idem_key", "utterance_id", "session_id",
+    "correlation_id", "uuid", "text", "utterance",
+)
+
+
+def _jl026_per_request_ident(node) -> Optional[str]:
+    """The terminal identifier of an expression, when it names
+    per-request identity (``req_id``, ``r.trace_id``,
+    ``payload["text"]``, ...)."""
+    if isinstance(node, ast.Name):
+        name = node.id
+    elif isinstance(node, ast.Attribute):
+        name = node.attr
+    elif (isinstance(node, ast.Subscript)
+            and isinstance(node.slice, ast.Constant)
+            and isinstance(node.slice.value, str)):
+        name = node.slice.value
+    else:
+        return None
+    low = name.lower()
+    for pat in _JL026_PER_REQUEST:
+        if low == pat or low.endswith("_" + pat):
+            return name
+    return None
+
+
+def rule_jl026(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL026: label-cardinality bomb — per-request identity (req_id,
+    trace_id, idempotency keys, raw text, ...) flowing into a metric
+    NAME or a label VALUE at a ``registry.counter/gauge/histogram``
+    call site under ``speakingstyle_tpu/serving/`` or ``obs/``.
+
+    A metric family costs memory per distinct (name, labels) identity,
+    FOREVER: counters never expire, every /metrics scrape renders every
+    series, and the fleet federation layer (obs/registry.merge_states)
+    multiplies the page across replicas. A label whose value is
+    per-request — ``labels={"req": req_id}``, a trace id interpolated
+    into the metric name — therefore allocates one immortal series per
+    request: memory grows linearly with traffic, scrape latency follows,
+    and the observability plane becomes the outage. Per-request identity
+    belongs on trace spans (bounded ring, obs/trace.py) and JSONL events
+    (append-only, rotated), never on metric labels; labels stay bounded
+    vocabularies (class, replica, reason, bucket). The rule keys on
+    identifier NAMES flowing into the call site, so bounded dynamic
+    labels (``{"class": klass}``, ``{"replica": rid}``) stay clean;
+    genuinely bounded values with unfortunate names get
+    ``# jaxlint: disable=JL026 reason=...``.
+    """
+    p = mod.path.replace("\\", "/")
+    if not ("speakingstyle_tpu/serving/" in p
+            or "speakingstyle_tpu/obs/" in p):
+        return
+    for node in mod.walk():
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _JL026_METHODS):
+            continue
+        # receiver must look like a metrics registry (self.registry,
+        # self._registry, registry, reg) — lexical, like every rule here
+        recv = (_dotted(node.func.value) or "").rsplit(".", 1)[-1]
+        if "registry" not in recv.lower() and recv != "reg":
+            continue
+        name_expr = node.args[0] if node.args else None
+        labels_expr = None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                name_expr = kw.value
+            elif kw.arg == "labels":
+                labels_expr = kw.value
+        hits: List[Tuple[str, str]] = []
+        if name_expr is not None and not isinstance(name_expr, ast.Constant):
+            # dynamic name: flag when per-request identity feeds it
+            # (f-string pieces, concat operands, or the variable itself)
+            for sub in ast.walk(name_expr):
+                ident = _jl026_per_request_ident(sub)
+                if ident is not None:
+                    hits.append(("the metric name", ident))
+                    break
+        if isinstance(labels_expr, ast.Dict):
+            for key, val in zip(labels_expr.keys, labels_expr.values):
+                for sub in ast.walk(val):
+                    ident = _jl026_per_request_ident(sub)
+                    if ident is not None:
+                        label = (key.value if isinstance(key, ast.Constant)
+                                 else _dotted(key) or "?")
+                        hits.append((f"label {label!r}", ident))
+                        break
+        for where, ident in hits:
+            fn = mod.enclosing_function(node)
+            qual = mod.qualname(fn or mod.tree)
+            yield Finding(
+                rule="JL026",
+                path=mod.path,
+                line=node.lineno,
+                context=qual,
+                detail=f"per-request `{ident}` in {where}",
+                message=(
+                    f"`{node.func.attr}(...)` in {qual} puts per-request "
+                    f"`{ident}` into {where}: each distinct value mints an "
+                    "immortal time series, so the /metrics page (and every "
+                    "federation merge over it) grows with traffic forever. "
+                    "Put per-request identity on trace spans or JSONL "
+                    "events; keep metric labels a bounded vocabulary "
+                    "(class, replica, reason, bucket)."
+                ),
+            )
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -2642,4 +2771,5 @@ RULES = {
     "JL023": rule_jl023,
     "JL024": rule_jl024,
     "JL025": rule_jl025,
+    "JL026": rule_jl026,
 }

@@ -78,6 +78,17 @@ def build_parser(parser=None):
 
 def main(args):
     cfg = config_from_args(args)
+    # replica half of the fleet observability plane: size this process's
+    # span ring and arm recording from the SAME serve.trace block the
+    # router uses, so a fleet-wide trace has every hop recorded
+    from speakingstyle_tpu.obs.trace import (
+        configure_span_ring,
+        set_tracing_enabled,
+    )
+
+    configure_span_ring(cfg.serve.trace.ring_capacity,
+                        keep_traces=cfg.serve.trace.keep_traces)
+    set_tracing_enabled(cfg.serve.trace.enabled)
     if args.coordinator_address:
         # multi-host replica: join the distributed runtime BEFORE any
         # device work so the engine's serve.parallel mesh sees every

@@ -179,6 +179,17 @@ def main(args):
     )
 
     cfg = config_from_args(args)
+    # fleet observability plane: size the span ring from config and arm
+    # (or disarm) span recording before any serving component starts
+    from speakingstyle_tpu.obs.trace import (
+        configure_span_ring,
+        get_span_ring,
+        set_tracing_enabled,
+    )
+
+    tcfg = cfg.serve.trace
+    configure_span_ring(tcfg.ring_capacity, keep_traces=tcfg.keep_traces)
+    set_tracing_enabled(tcfg.enabled)
     # ONE deterministic fault plan from SPEAKINGSTYLE_FAULTS, threaded to
     # every serving component — a single shared plan keeps the @N counters
     # exact (building a plan per component would double-fire each entry)
@@ -419,6 +430,23 @@ def main(args):
             print(f"ring tier ready in {ring_secs:.1f}s", flush=True)
             server.longform.ring = ring
 
+    # SLO burn-rate engine (obs/slo.py): multi-window burn rates per
+    # traffic class against serve.slo.objectives, published as
+    # serve_slo_burn_rate gauges + slo_alert events + /healthz slo block
+    slo = None
+    if cfg.serve.slo.enabled:
+        from speakingstyle_tpu.obs.slo import SloEngine
+
+        slo = SloEngine(server.registry, cfg.serve.slo, events=events,
+                        trace_ring=get_span_ring())
+        server.slo = slo
+        print(
+            f"SLO engine armed: objectives "
+            f"{dict(cfg.serve.slo.objectives)}, windows "
+            f"{cfg.serve.slo.fast_window_s:g}s/"
+            f"{cfg.serve.slo.slow_window_s:g}s", flush=True,
+        )
+
     # SIGTERM contract: stop accepting, drain in-flight streams (up to
     # serve.fleet.drain_timeout_s), flush admitted requests, exit.
     # shutdown() must run off the serve_forever thread.
@@ -450,6 +478,8 @@ def main(args):
         # landing mid-shutdown would race the router's own teardown
         if autoscaler is not None:
             autoscaler.close()
+        if slo is not None:
+            slo.close()
         server.shutdown()
         if events is not None:
             events.close()

@@ -1164,6 +1164,110 @@ class TiersConfig:
 
 
 @dataclass(frozen=True)
+class TraceConfig:
+    """Distributed-tracing knobs (obs/trace.py — ARCHITECTURE.md
+    "Fleet observability plane").
+
+    Context propagation is always on (three strings riding each
+    request); these knobs govern span *recording*: the bounded
+    per-process ring served at ``GET /debug/spans``, and the tail
+    sampler's healthy-traffic keep rate.  Every shed/504/hedge-won/
+    deadline-miss trace is kept regardless of ``sample_rate`` — tail
+    sampling only thins the healthy majority.
+    """
+
+    enabled: bool = True
+    # bounded per-process finished-span ring (oldest evicted first)
+    ring_capacity: int = 4096
+    # bounded keep-store of pinned (tail-sampled) traces
+    keep_traces: int = 256
+    # deterministic keep probability for *healthy* traces; interesting
+    # traces (error ladder, hedge winner, deadline miss) always keep
+    sample_rate: float = 0.1
+
+    def __post_init__(self):
+        if self.ring_capacity < 1:
+            raise ValueError(
+                f"serve.trace.ring_capacity must be >= 1, "
+                f"got {self.ring_capacity}"
+            )
+        if self.keep_traces < 1:
+            raise ValueError(
+                f"serve.trace.keep_traces must be >= 1, "
+                f"got {self.keep_traces}"
+            )
+        if not (0.0 <= self.sample_rate <= 1.0):
+            raise ValueError(
+                f"serve.trace.sample_rate must be in [0, 1], "
+                f"got {self.sample_rate}"
+            )
+
+
+@dataclass(frozen=True)
+class SloConfig:
+    """Multi-window burn-rate SLO accounting (obs/slo.py).
+
+    Per traffic class, ``objectives`` states the availability target —
+    the fraction of admitted requests that must resolve inside their
+    deadline (neither shed after admission, nor 504ed, nor served past
+    their SLO stamp). The engine differentiates the fleet's cumulative
+    miss/shed/request counters into two sliding windows and publishes
+
+        burn_rate = (bad / total) / (1 - objective)
+
+    per (class, window) as ``serve_slo_burn_rate`` gauges: burn 1.0
+    consumes the error budget exactly at sustainable rate. An alert
+    (``slo_alert`` JSONL event) fires only when BOTH windows burn past
+    their thresholds — the standard multi-window rule: the fast window
+    catches the page-worthy spike, the slow window keeps one transient
+    blip from paging.
+    """
+
+    enabled: bool = True
+    # traffic class -> availability objective (fraction of requests that
+    # must meet their deadline); classes absent here are not tracked
+    objectives: Dict[str, float] = field(
+        default_factory=lambda: {"interactive": 0.999, "batch": 0.99}
+    )
+    # sliding windows the cumulative counters are differentiated over
+    fast_window_s: float = 60.0
+    slow_window_s: float = 600.0
+    # burn-rate thresholds per window (SRE handbook pairing: 14.4x burns
+    # a 30-day budget in 2 days; 6x in 5 days)
+    fast_burn_threshold: float = 14.4
+    slow_burn_threshold: float = 6.0
+    # evaluation cadence of the stop-aware policy loop
+    tick_s: float = 5.0
+
+    def __post_init__(self):
+        for klass, obj in self.objectives.items():
+            if not (0.0 < obj < 1.0):
+                raise ValueError(
+                    f"serve.slo.objectives[{klass!r}] must be in (0, 1), "
+                    f"got {obj}"
+                )
+        if self.fast_window_s <= 0:
+            raise ValueError(
+                f"serve.slo.fast_window_s must be > 0, "
+                f"got {self.fast_window_s}"
+            )
+        if self.slow_window_s <= self.fast_window_s:
+            raise ValueError(
+                "serve.slo.slow_window_s must be > fast_window_s, got "
+                f"{self.slow_window_s} <= {self.fast_window_s}"
+            )
+        if self.fast_burn_threshold <= 0 or self.slow_burn_threshold <= 0:
+            raise ValueError(
+                "serve.slo burn thresholds must be > 0, got "
+                f"{self.fast_burn_threshold}/{self.slow_burn_threshold}"
+            )
+        if self.tick_s <= 0:
+            raise ValueError(
+                f"serve.slo.tick_s must be > 0, got {self.tick_s}"
+            )
+
+
+@dataclass(frozen=True)
 class ServeConfig:
     """Continuous-batching synthesis server knobs (serving/engine.py,
     serving/batcher.py).
@@ -1243,6 +1347,10 @@ class ServeConfig:
     # keep a mesh replica bit-identical to the 1x1 one from the same
     # checkpoint (the cross-mesh serving contract).
     parallel: ParallelConfig = field(default_factory=ParallelConfig)
+    # distributed tracing: span ring sizing + tail-sampling keep rate
+    trace: TraceConfig = field(default_factory=TraceConfig)
+    # multi-window SLO burn-rate accounting per traffic class
+    slo: SloConfig = field(default_factory=SloConfig)
 
     def __post_init__(self):
         for name in ("batch_buckets", "src_buckets", "mel_buckets"):

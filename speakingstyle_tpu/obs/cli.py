@@ -19,6 +19,18 @@ compute roofline numbers from the recorded step times:
   (the chip's peak, e.g. 1.97e14 for v5e bf16) adds a model-FLOPs
   utilization percentage.
 
+``trace`` subcommand — assemble and pretty-print distributed trace
+spans (obs/trace.py records, the fleet observability plane):
+
+  python -m speakingstyle_tpu.obs.cli trace SPANS [TRACE_ID]
+
+  SPANS is a ``GET /debug/spans`` dump (JSON object with ``spans`` +
+  ``kept``), a bare JSON list of span records, or a JSONL file (one
+  span per line).  With no TRACE_ID it lists the traces in the file;
+  with one it prints the span tree — per-span durations, fields, span
+  events — with the critical path (the last-exit chain that gated
+  end-to-end latency) marked ``*`` and summarized at the bottom.
+
 No jax import — safe to run on a login node against a live run's logs.
 """
 
@@ -158,8 +170,138 @@ def programs(path, peak_flops=None, out=None):
     return 0
 
 
+def build_trace_parser(parser=None):
+    parser = parser or argparse.ArgumentParser(
+        prog="python -m speakingstyle_tpu.obs.cli trace",
+        description="assemble + pretty-print distributed trace spans",
+    )
+    parser.add_argument(
+        "path",
+        help="a GET /debug/spans dump (JSON), a bare JSON list of span "
+             "records, or a JSONL file with one span per line",
+    )
+    parser.add_argument(
+        "trace_id", nargs="?", default=None,
+        help="trace to render; omitted = list the traces in the file",
+    )
+    return parser
+
+
+def _load_spans(path):
+    """Span records from a ``/debug/spans`` dump (object with
+    ``spans`` + ``kept``), a bare JSON list, or a JSONL file."""
+    with open(path) as fh:
+        text = fh.read()
+    spans = []
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # a JSONL log may interleave non-span lines
+            if isinstance(rec, dict):
+                spans.append(rec)
+    else:
+        if isinstance(doc, list):
+            spans = [s for s in doc if isinstance(s, dict)]
+        elif isinstance(doc, dict):
+            spans = [s for s in doc.get("spans", []) if isinstance(s, dict)]
+            for kept in (doc.get("kept") or {}).values():
+                spans.extend(s for s in kept if isinstance(s, dict))
+    # dedup by span_id: a tail-kept trace's spans also sit in the ring
+    seen, out = set(), []
+    for s in spans:
+        sid = s.get("span_id")
+        if sid in seen:
+            continue
+        if sid:
+            seen.add(sid)
+        out.append(s)
+    return out
+
+
+def _fields_text(fields):
+    return " ".join(f"{k}={v}" for k, v in sorted(fields.items()))
+
+
+def trace(path, trace_id=None, out=None):
+    """Render one assembled trace as a stage tree (or, with no
+    ``trace_id``, list the traces a span dump holds)."""
+    from speakingstyle_tpu.obs.trace import assemble_trace
+
+    out = out if out is not None else sys.stdout  # late-bound: capturable
+    spans = [s for s in _load_spans(path) if s.get("trace_id")]
+    if not spans:
+        print(f"no span records under {path}", file=out)
+        return 1
+    if trace_id is None:
+        by_trace = collections.defaultdict(list)
+        for s in spans:
+            by_trace[s["trace_id"]].append(s)
+        print(f"{len(by_trace)} trace(s) in {path}:", file=out)
+        for tid, group in sorted(
+            by_trace.items(), key=lambda kv: (-len(kv[1]), kv[0])
+        ):
+            root = next(
+                (s.get("name") for s in group
+                 if not s.get("parent_span_id")), "?",
+            )
+            span_s = sum(s.get("duration_s") or 0.0 for s in group)
+            print(f"  {tid}  {len(group):3d} span(s)  "
+                  f"{span_s * 1e3:9.1f} ms span time  root={root}", file=out)
+        return 0
+    view = assemble_trace(spans, trace_id)
+    if not view["span_count"]:
+        print(f"trace {trace_id} not found in {path}", file=out)
+        return 1
+    print(f"trace {trace_id}: {view['span_count']} span(s), "
+          f"{view['total_s'] * 1e3:.1f} ms end-to-end "
+          "(* = critical path)", file=out)
+
+    def render(node, depth):
+        mark = "*" if node["on_critical_path"] else " "
+        dur = (node.get("duration_s") or 0.0) * 1e3
+        label = "  " * depth + str(node.get("name"))
+        line = f"  {mark} {label:<40s} {dur:9.1f} ms"
+        extra = _fields_text(node.get("fields") or {})
+        if extra:
+            line += f"  {extra}"
+        if not node.get("ok", True):
+            line += "  ERROR"
+        print(line, file=out)
+        for ev in node.get("events") or []:
+            detail = _fields_text(
+                {k: v for k, v in ev.items() if k not in ("name", "ts")}
+            )
+            print("    " + "  " * depth + f"· {ev.get('name')}"
+                  + (f" {detail}" if detail else ""), file=out)
+        for child in node["children"]:
+            render(child, depth + 1)
+
+    for root in view["roots"]:
+        render(root, 0)
+    cp = view["critical_path"]
+    if cp:
+        chain = " > ".join(str(s.get("name")) for s in cp)
+        gate = cp[-1]
+        print(f"critical path: {chain}", file=out)
+        print(f"  gated by {gate.get('name')} "
+              f"({(gate.get('duration_s') or 0.0) * 1e3:.1f} ms"
+              + (f"; {_fields_text(gate.get('fields') or {})}"
+                 if gate.get("fields") else "") + ")", file=out)
+    return 0
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
+    if argv and argv[0] == "trace":
+        args = build_trace_parser().parse_args(argv[1:])
+        return trace(args.path, trace_id=args.trace_id)
     if argv and argv[0] == "programs":
         args = build_programs_parser().parse_args(argv[1:])
         return programs(args.path, peak_flops=args.peak_flops)

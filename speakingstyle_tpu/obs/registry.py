@@ -200,6 +200,42 @@ class Histogram:
                 return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
         return hi_seen
 
+    def export_state(self) -> Dict:
+        """Raw mergeable state: per-bin (non-cumulative) counts plus the
+        running sum/min/max.  This — not the percentile estimates — is
+        what crosses the federation wire: a fleet p999 must come from
+        bucket counts merged across replicas, never from averaging
+        per-replica percentiles (`merge_states`)."""
+        counts, count, total, lo, hi = self._state()
+        return {
+            "edges": list(self.edges),
+            "counts": counts,
+            "count": count,
+            "sum": total,
+            "min": lo,
+            "max": hi,
+        }
+
+    def _absorb_state(self, state: Mapping) -> None:
+        """Merge raw exported state into this histogram (same edges
+        required — the caller guarantees it). Federation-internal."""
+        counts = state.get("counts") or []
+        with self._lock:
+            for i, c in enumerate(counts[: len(self._counts)]):
+                self._counts[i] += int(c)
+            self._count += int(state.get("count") or 0)
+            self._sum += float(state.get("sum") or 0.0)
+            for key, better in (("min", min), ("max", max)):
+                v = state.get(key)
+                if v is None:
+                    continue
+                mine = self._min if key == "min" else self._max
+                merged = v if mine is None else better(mine, v)
+                if key == "min":
+                    self._min = merged
+                else:
+                    self._max = merged
+
     def snapshot(self) -> Dict:
         counts, count, total, lo, hi = self._state()
         cum, buckets = 0, {}
@@ -335,6 +371,86 @@ class MetricsRegistry:
                         f"{snap['max']:g}"
                     )
         return "\n".join(lines) + "\n"
+
+
+    def export_state(self) -> Dict:
+        """JSON-safe raw state of every metric — the federation wire
+        format a replica serves at ``GET /metrics`` on its control
+        socket.  Counters/gauges ship their value; histograms ship raw
+        bucket counts (``Histogram.export_state``) so the router can
+        merge them bucket-wise."""
+        metrics = []
+        for (name, labels), m in self._items():
+            rec: Dict = {
+                "name": name,
+                "kind": m.kind,
+                "labels": [list(kv) for kv in labels],
+            }
+            if isinstance(m, (Counter, Gauge)):
+                rec["value"] = m.value
+            else:
+                rec["hist"] = m.export_state()
+            metrics.append(rec)
+        return {"metrics": metrics}
+
+
+def merge_states(
+    states: Sequence[Tuple[str, Mapping]],
+    prefix: str = "fleet_",
+) -> MetricsRegistry:
+    """Fold per-replica exported states into one merged registry — the
+    federation semantics:
+
+      * **counters** are summed across replicas under the same
+        (name, labels) identity;
+      * **histograms** merge *bucket counts* elementwise (same edges),
+        so every percentile read off the merged registry — including
+        the exported ``_p999`` line — is computed from fleet-wide
+        buckets, never from averaged per-replica percentiles.  A
+        replica whose edges diverge (config skew mid-rollout) falls
+        back to a ``replica=``-labeled copy instead of corrupting the
+        merge;
+      * **gauges** are levels, not flows — summing them is meaningless,
+        so each replica's gauge is kept under an added ``replica=``
+        label.
+
+    ``prefix`` namespaces the merged families (default ``fleet_``) so
+    the router's own process metrics never collide with the federated
+    view on one ``/metrics`` page.
+    """
+    merged = MetricsRegistry()
+    for rid, state in states:
+        for rec in (state or {}).get("metrics", []):
+            name = prefix + str(rec.get("name", ""))
+            labels = {k: v for k, v in (rec.get("labels") or [])}
+            kind = rec.get("kind")
+            if kind == "counter":
+                merged.counter(name, labels=labels).inc(
+                    float(rec.get("value") or 0.0))
+            elif kind == "gauge":
+                merged.gauge(
+                    name, labels={**labels, "replica": rid}
+                ).set(float(rec.get("value") or 0.0))
+            elif kind == "histogram":
+                hist_state = rec.get("hist") or {}
+                edges = tuple(float(e) for e in
+                              (hist_state.get("edges") or ()))
+                if not edges:
+                    continue
+                try:
+                    h = merged.histogram(name, edges=edges, labels=labels)
+                except TypeError:
+                    continue   # name collides with another kind: skip
+                if h.edges != edges:
+                    # config skew: this replica's buckets don't line up
+                    # with the fleet's — keep it separately rather than
+                    # adding apples to oranges
+                    h = merged.histogram(
+                        name, edges=edges,
+                        labels={**labels, "replica": rid},
+                    )
+                h._absorb_state(hist_state)
+    return merged
 
 
 _default_lock = threading.Lock()

@@ -229,6 +229,10 @@ class Autoscaler:
                 "autoscale", decision=direction, reason=reason,
                 target=target, warmup_cost_s=round(self.warmup_cost_s(), 3),
                 queue_cap=self.router.fleet.queue_depth,
+                # the most recent pressure-pinned trace: an example of
+                # the traffic that tripped (or calmed) this decision
+                trace_id=getattr(self.router,
+                                 "last_pressure_trace_id", None),
                 **{k: (round(v, 4) if isinstance(v, float) else v)
                    for k, v in signals.items()},
             )

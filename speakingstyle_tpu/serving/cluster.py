@@ -78,7 +78,10 @@ import numpy as np
 
 from speakingstyle_tpu.faults import FaultPlan
 from speakingstyle_tpu.obs import JsonlEventLog, MetricsRegistry
+from speakingstyle_tpu.obs import trace as obstrace
 from speakingstyle_tpu.obs.locks import make_lock
+from speakingstyle_tpu.obs.registry import merge_states
+from speakingstyle_tpu.obs.trace import Span, TraceContext, get_span_ring
 from speakingstyle_tpu.serving.engine import (
     SynthesisRequest,
     SynthesisResult,
@@ -168,6 +171,9 @@ def encode_request(r: SynthesisRequest) -> Dict:
         "d_control": _enc_ctl(r.d_control),
         "stream": bool(r.stream),
         "style_degraded": bool(r.style_degraded),
+        # the propagated trace context: three strings, riding the body
+        # (per request — one coalesced dispatch can carry many traces)
+        "trace": r.trace.as_dict() if r.trace is not None else None,
     }
 
 
@@ -190,6 +196,7 @@ def decode_request(d: Dict) -> SynthesisRequest:
         d_control=_dec_ctl(d["d_control"]),
         stream=d.get("stream", False),
         style_degraded=d.get("style_degraded", False),
+        trace=TraceContext.from_dict(d.get("trace")),
     )
 
 
@@ -368,14 +375,17 @@ class LeaseTable:
 
 
 def _post_json(host: str, port: int, path: str, payload: Dict,
-               timeout: float) -> Tuple[int, Dict]:
+               timeout: float,
+               headers: Optional[Dict[str, str]] = None) -> Tuple[int, Dict]:
     """One bounded JSON round-trip (every wire call in this module has
     an explicit timeout — jaxlint JL024)."""
     conn = HTTPConnection(host, port, timeout=timeout)
     try:
         body = json.dumps(payload).encode("utf-8")
-        conn.request("POST", path, body=body,
-                     headers={"Content-Type": "application/json"})
+        hdrs = {"Content-Type": "application/json"}
+        if headers:
+            hdrs.update(headers)
+        conn.request("POST", path, body=body, headers=hdrs)
         resp = conn.getresponse()
         data = resp.read()
         try:
@@ -405,7 +415,8 @@ def _get_json(host: str, port: int, path: str,
 
 class _JsonHandler(BaseHTTPRequestHandler):
     """Shared request plumbing: subclasses map (method, path) -> a
-    callable returning ``(status, payload_dict)``."""
+    callable ``(body, headers) -> (status, payload_dict)`` — headers
+    carry the ``X-Trace-*`` propagation fields."""
 
     protocol_version = "HTTP/1.1"
     # a wedged peer must not pin a handler thread forever
@@ -437,7 +448,7 @@ class _JsonHandler(BaseHTTPRequestHandler):
             return
         try:
             body = self._read_body() if method == "POST" else {}
-            status, payload = handler(body)
+            status, payload = handler(body, self.headers)
         except BrokenPipeError:
             raise
         except Exception as e:  # a handler bug answers 500, not a hang
@@ -529,10 +540,15 @@ class ReplicaServer:
             "serve_wire_dispatches_total",
             help="wire dispatches executed by this replica process",
         )
+        # single-flight latch for the fan-out profile endpoint
+        self._profiling = threading.Event()
         self._httpd = _JsonServer((host, port), {
             ("GET", "/healthz"): self._handle_healthz,
             ("POST", "/dispatch"): self._handle_dispatch,
             ("POST", "/drain"): self._handle_drain,
+            ("GET", "/metrics"): self._handle_metrics,
+            ("GET", "/debug/spans"): self._handle_spans,
+            ("POST", "/debug/profile"): self._handle_profile,
         })
         self.host = host
         self.port = self._httpd.server_address[1]
@@ -634,7 +650,7 @@ class ReplicaServer:
 
     # -- endpoints ----------------------------------------------------------
 
-    def _handle_healthz(self, body: Dict) -> Tuple[int, Dict]:
+    def _handle_healthz(self, body: Dict, headers=None) -> Tuple[int, Dict]:
         ready = self._ready()
         return (200 if ready else 503), {
             "ready": ready,
@@ -647,15 +663,73 @@ class ReplicaServer:
             "idempotent_hits": int(self._idem_hits.value),
         }
 
-    def _handle_drain(self, body: Dict) -> Tuple[int, Dict]:
+    def _handle_drain(self, body: Dict, headers=None) -> Tuple[int, Dict]:
         self._draining = True
         return 200, {"ok": True, "replica_id": self.replica_id}
 
-    def _handle_dispatch(self, body: Dict) -> Tuple[int, Dict]:
+    def _handle_metrics(self, body: Dict, headers=None) -> Tuple[int, Dict]:
+        """Raw registry state for the router's federation scraper:
+        counters/gauges plus histograms with their raw bucket counts, so
+        the router merges buckets instead of averaging percentiles."""
+        return 200, self.registry.export_state()
+
+    def _handle_spans(self, body: Dict, headers=None) -> Tuple[int, Dict]:
+        """This process's span ring + tail-sampled keep-store — the
+        router's trace assembler stitches these with its own spans."""
+        ring = get_span_ring()
+        return 200, {
+            "replica_id": self.replica_id,
+            "spans": ring.spans(),
+            "kept": {tid: ring.spans(tid)
+                     for tid in ring.kept_trace_ids()},
+            "stats": ring.stats(),
+        }
+
+    def _handle_profile(self, body: Dict, headers=None) -> Tuple[int, Dict]:
+        """One bounded jax.profiler capture, off-thread (the handler
+        answers immediately; the fan-out hits every replica at once).
+        Single-flight: a capture already running answers 409."""
+        secs = min(60.0, max(0.05, float(body.get("seconds", 1.0) or 1.0)))
+        out_dir = str(body.get("dir")
+                      or f"/tmp/jax-profile-{self.replica_id}")
+        if self._profiling.is_set():
+            return 409, {"error": "profile already running",
+                         "replica_id": self.replica_id}
+        self._profiling.set()
+
+        def _capture() -> None:
+            try:
+                import jax
+                jax.profiler.start_trace(out_dir)
+                try:
+                    self._stop.wait(secs)   # stop-aware, never a bare sleep
+                finally:
+                    jax.profiler.stop_trace()
+            except Exception as e:
+                # best-effort: profiling never takes a replica down, but
+                # the failure is counted so a dead fan-out is visible
+                self.registry.counter(
+                    "replica_profile_errors_total",
+                    labels={"error": type(e).__name__},
+                    help="failed jax.profiler captures by error type",
+                ).inc()
+            finally:
+                self._profiling.clear()
+
+        threading.Thread(
+            target=_capture, name=f"replica-{self.replica_id}-profile",
+            daemon=True,
+        ).start()
+        return 200, {"ok": True, "replica_id": self.replica_id,
+                     "dir": out_dir, "seconds": secs}
+
+    def _handle_dispatch(self, body: Dict, headers=None) -> Tuple[int, Dict]:
         if self._draining:
             return 503, {"error": "draining"}
         key = body.get("key", "")
         reqs = body.get("requests", [])
+        hedge_leg = (headers.get("X-Hedge-Leg")
+                     if headers is not None else None) or "primary"
         served_by = f"{self.host}:{self.port}"
         # exactly-once via check-then-claim-then-store: the lock guards
         # only the cache + in-flight bookkeeping (never engine.run — the
@@ -688,13 +762,30 @@ class ReplicaServer:
                 return 503, {"error": "stopping"}
         try:
             requests = [decode_request(d) for d in reqs]
+            t0_wall = time.time()     # span start_ts: wall, cross-process
+            t0 = time.monotonic()     # span duration: monotonic (JL009)
             results = self.engine.run(requests)
+            dt = time.monotonic() - t0
             payload = {
                 "served_by": served_by,
                 "replica_id": self.replica_id,
                 "results": [encode_result(r) for r in results],
                 "idempotent": False,
             }
+            # one replica_dispatch span per distinct trace in the batch,
+            # recorded after the fact so tracing never sits on the wire
+            # path; the engine's own engine_run spans land as siblings
+            seen_traces = set()
+            for r in requests:
+                ctx = getattr(r, "trace", None)
+                if ctx is None or ctx.trace_id in seen_traces:
+                    continue
+                seen_traces.add(ctx.trace_id)
+                Span.record(
+                    "replica_dispatch", t0_wall, dt, parent=ctx,
+                    replica=self.replica_id, rows=len(requests),
+                    hedge_leg=hedge_leg,
+                )
         except BaseException:
             if key:
                 with self._dispatch_lock:
@@ -825,6 +916,23 @@ class RemoteEngine:
             "key": key,
             "requests": [encode_request(r) for r in requests],
         }).encode("utf-8")
+        # the distinct trace contexts this dispatch carries: every leg
+        # records one "remote_dispatch" span per trace, so hedge legs
+        # appear as SIBLINGS under the request's router-side span, each
+        # tagged with hedge_leg= and (exactly one) winner=True
+        traces: List[TraceContext] = []
+        seen_tids: set = set()
+        for r in requests:
+            t_ctx = getattr(r, "trace", None)
+            if t_ctx is not None and t_ctx.trace_id not in seen_tids:
+                seen_tids.add(t_ctx.trace_id)
+                traces.append(t_ctx)
+        wire_headers = {}
+        if traces:
+            # the header-level join (per ISSUE: X-Trace-* rides the
+            # wire); the body carries the full per-request contexts
+            wire_headers["X-Trace-Id"] = traces[0].trace_id
+            wire_headers["X-Parent-Span"] = traces[0].span_id or ""
 
         hedge_enabled = c.ccfg.hedge_quantile > 0.0
         hedge_delay = self._hedge_delay_s(klass)
@@ -835,18 +943,48 @@ class RemoteEngine:
         out_q: "queue.Queue" = queue.Queue(maxsize=4)
         conns: Dict[str, HTTPConnection] = {}
         threads: List[threading.Thread] = []
+        leg_recs: Dict[str, List[Dict]] = {}
+
+        def record_leg(tag: str, host: str, port: int, t0_wall: float,
+                       dt: float, err: Optional[BaseException]) -> None:
+            """One remote_dispatch span per trace this leg carried.  The
+            ring stores dict references, so the winner flag can be set
+            in place once the race resolves."""
+            if not traces or not obstrace.tracing_enabled():
+                return
+            ring = get_span_ring()
+            recs = []
+            for ctx in traces:
+                child = ctx.child()
+                rec: Dict = {
+                    "name": "remote_dispatch",
+                    "start_ts": t0_wall,
+                    "duration_s": dt,
+                    **child.as_dict(),
+                    "fields": {"hedge_leg": tag,
+                               "target": f"{host}:{port}"},
+                }
+                if err is not None:
+                    rec["ok"] = False
+                    rec["error"] = f"{type(err).__name__}: {err}"
+                ring.add(rec)
+                recs.append(rec)
+            leg_recs[tag] = recs
 
         def leg(host: str, port: int, tag: str) -> None:
             t0 = time.monotonic()
+            t0_wall = time.time()
+            hdrs = {"Content-Type": "application/json",
+                    "X-Hedge-Leg": tag}
+            hdrs.update(wire_headers)
             conn = HTTPConnection(
                 host, port, timeout=max(0.05, deadline - t0)
             )
             conns[tag] = conn
+            err_out: Optional[BaseException] = None
             try:
-                conn.request(
-                    "POST", "/dispatch", body=payload,
-                    headers={"Content-Type": "application/json"},
-                )
+                conn.request("POST", "/dispatch", body=payload,
+                             headers=hdrs)
                 resp = conn.getresponse()
                 data = resp.read()
                 if resp.status != 200:
@@ -860,12 +998,15 @@ class RemoteEngine:
                 except queue.Full:
                     pass
             except BaseException as e:
+                err_out = e
                 try:
                     out_q.put((tag, time.monotonic() - t0, None, e),
                               timeout=1.0)
                 except queue.Full:
                     pass
             finally:
+                record_leg(tag, host, port, t0_wall,
+                           time.monotonic() - t0, err_out)
                 conn.close()
 
         def fire(host: str, port: int, tag: str) -> None:
@@ -962,6 +1103,10 @@ class RemoteEngine:
                 f"{last_err}"
             ) from last_err
         tag, dt, body = winner
+        # all legs are joined: leg_recs is stable — flag the winner's
+        # spans in place (the ring holds these same dict objects)
+        for rec in leg_recs.get(tag, []):
+            rec.setdefault("fields", {})["winner"] = True
         self._registry.histogram(
             "serve_wire_latency_seconds", labels={"class": klass},
             help="winning wire dispatch round-trip per priority class "
@@ -972,6 +1117,9 @@ class RemoteEngine:
                 "serve_hedge_won_total", labels={"class": klass},
                 help="dispatches won by the hedge leg",
             ).inc()
+            # a hedge win is a tail event by definition: pin its traces
+            for t_ctx in traces:
+                c._note_pressure(t_ctx, "hedge_won")
         served_by = body.get("served_by") or f"{self.host}:{self.port}"
         return [decode_result(d, served_by=served_by)
                 for d in body.get("results", [])]
@@ -1025,7 +1173,7 @@ class ClusterRouter(FleetRouter):
             (ccfg.control_host, ccfg.control_port), {
                 ("POST", "/register"): self._handle_register,
                 ("POST", "/heartbeat"): self._handle_heartbeat,
-                ("GET", "/cluster"): lambda body: (200, {
+                ("GET", "/cluster"): lambda body, headers=None: (200, {
                     "replicas": self.cluster_stats()
                 }),
             })
@@ -1058,6 +1206,26 @@ class ClusterRouter(FleetRouter):
             name="cluster-lease-sweeper", daemon=True,
         )
         self._cluster_thread.start()
+        # metrics federation: scrape each live replica's /metrics on a
+        # stop-aware cadence into a cache the router's own /metrics
+        # handler merges (merge_states) — fleet p999 comes from merged
+        # buckets, never from averaged percentiles
+        self._fed_lock = make_lock("ClusterRouter._fed_lock")
+        self._fed_states: Dict[str, Dict] = {}
+        self._fed_scrapes = self.registry.counter(
+            "serve_federation_scrapes_total",
+            help="replica /metrics scrapes the federator completed",
+        )
+        self._fed_errors = self.registry.counter(
+            "serve_federation_errors_total",
+            help="replica /metrics scrapes that failed (unreachable, "
+                 "partitioned, bad payload)",
+        )
+        self._fed_thread = threading.Thread(
+            target=self._federate,
+            name="cluster-metrics-federator", daemon=True,
+        )
+        self._fed_thread.start()
 
     @property
     def control_addr(self) -> str:
@@ -1077,7 +1245,7 @@ class ClusterRouter(FleetRouter):
 
     # -- control-plane endpoints -------------------------------------------
 
-    def _handle_register(self, body: Dict) -> Tuple[int, Dict]:
+    def _handle_register(self, body: Dict, headers=None) -> Tuple[int, Dict]:
         rid = str(body.get("replica_id", ""))
         if not rid:
             return 400, {"error": "missing replica_id"}
@@ -1104,7 +1272,8 @@ class ClusterRouter(FleetRouter):
             "heartbeat_interval_s": self.ccfg.heartbeat_interval_s,
         }
 
-    def _handle_heartbeat(self, body: Dict) -> Tuple[int, Dict]:
+    def _handle_heartbeat(self, body: Dict,
+                          headers=None) -> Tuple[int, Dict]:
         rid = str(body.get("replica_id", ""))
         if self.is_partitioned(rid):
             return 503, {"error": "partitioned"}
@@ -1292,6 +1461,112 @@ class ClusterRouter(FleetRouter):
             return host, int(port), rid
         return None
 
+    # -- metrics federation + trace fan-in ----------------------------------
+
+    def _federate(self) -> None:
+        """Scrape live replicas' /metrics into the federation cache.
+
+        Lock discipline: every wire call runs with NO lock held; the
+        cache swap under ``_fed_lock`` is pure dict work (JL021).  A
+        lease-expired or partitioned replica is skipped — and dropped
+        from the cache, so its frozen counters stop polluting the
+        merged view until it re-registers."""
+        interval = max(0.05, self.ccfg.heartbeat_interval_s)
+        while not self.stopped.wait(interval):
+            now = time.monotonic()
+            rows = self.leases.snapshot(now)
+            fresh: Dict[str, Dict] = {}
+            live = set()
+            for row in rows:
+                rid = row["replica_id"]
+                if row["expired"] or self.is_partitioned(rid):
+                    continue
+                live.add(rid)
+                host, _, port = row["host"].rpartition(":")
+                try:
+                    status, state = _get_json(
+                        host, int(port), "/metrics",
+                        timeout=self.ccfg.connect_timeout_s,
+                    )
+                except OSError:
+                    status, state = 0, {}
+                if status == 200 and isinstance(
+                        state.get("metrics"), list):
+                    fresh[rid] = state
+                    self._fed_scrapes.inc()
+                else:
+                    self._fed_errors.inc()
+            with self._fed_lock:
+                self._fed_states.update(fresh)
+                for rid in list(self._fed_states):
+                    if rid not in live:
+                        self._fed_states.pop(rid)
+
+    def federated_states(self) -> List[Tuple[str, Dict]]:
+        """The latest scraped ``(replica_id, export_state)`` pairs."""
+        with self._fed_lock:
+            return sorted(self._fed_states.items())
+
+    def federated_registry(self) -> MetricsRegistry:
+        """The fleet-merged view: counters summed, histogram buckets
+        merged elementwise, gauges ``replica=``-labeled — the
+        ``fleet_*`` series the router's /metrics appends."""
+        return merge_states(self.federated_states())
+
+    def fetch_remote_spans(
+        self, trace_id: Optional[str] = None
+    ) -> List[Dict]:
+        """Pull replica-side spans for cross-process trace assembly
+        (``GET /debug/trace/<req_id>``). Best-effort: unreachable or
+        partitioned replicas contribute nothing; ring + keep-store
+        duplicates dedup by span_id."""
+        out: Dict[str, Dict] = {}
+        for row in self.leases.snapshot(time.monotonic()):
+            rid = row["replica_id"]
+            if row["expired"] or self.is_partitioned(rid):
+                continue
+            host, _, port = row["host"].rpartition(":")
+            try:
+                status, payload = _get_json(
+                    host, int(port), "/debug/spans",
+                    timeout=self.ccfg.connect_timeout_s,
+                )
+            except OSError:
+                continue
+            if status != 200:
+                continue
+            cand = list(payload.get("spans", []))
+            for kept in (payload.get("kept") or {}).values():
+                cand.extend(kept)
+            for s in cand:
+                if trace_id is not None \
+                        and s.get("trace_id") != trace_id:
+                    continue
+                sid = s.get("span_id")
+                if sid:
+                    out[sid] = s
+        return list(out.values())
+
+    def profile_fanout(self, seconds: float = 1.0) -> Dict[str, bool]:
+        """POST /debug/profile to every live replica at once — one
+        fleet-wide jax.profiler capture window."""
+        out: Dict[str, bool] = {}
+        for row in self.leases.snapshot(time.monotonic()):
+            rid = row["replica_id"]
+            if row["expired"] or self.is_partitioned(rid):
+                continue
+            host, _, port = row["host"].rpartition(":")
+            try:
+                status, _body = _post_json(
+                    host, int(port), "/debug/profile",
+                    {"seconds": seconds},
+                    timeout=self.ccfg.connect_timeout_s,
+                )
+                out[rid] = status == 200
+            except OSError:
+                out[rid] = False
+        return out
+
     # -- lease sweep + reap -------------------------------------------------
 
     def _cluster_supervise(self) -> None:
@@ -1407,6 +1682,8 @@ class ClusterRouter(FleetRouter):
         super().close(flush=flush, timeout=timeout)
         if self._cluster_thread.is_alive():
             self._cluster_thread.join(timeout=5.0)
+        if self._fed_thread.is_alive():
+            self._fed_thread.join(timeout=5.0)
         with self._proc_lock:
             procs = dict(self._procs)
             self._procs = {}

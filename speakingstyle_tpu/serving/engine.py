@@ -77,6 +77,7 @@ from speakingstyle_tpu.configs.config import Config
 from speakingstyle_tpu.faults import FaultPlan
 from speakingstyle_tpu.obs import CompileMonitor, MetricsRegistry
 from speakingstyle_tpu.obs.cost import FLOPS_PER_SEC_BUCKETS
+from speakingstyle_tpu.obs.trace import Span, TraceContext
 from speakingstyle_tpu.parallel.mesh import dispatch_sharding, resolve_mesh
 from speakingstyle_tpu.parallel.partition import (
     parse_rule_overrides,
@@ -150,6 +151,9 @@ class SynthesisRequest:
     # None = the engine's default precision. Stamped by the TierRouter
     # (serving/tiers.py) from the request's traffic class.
     precision: Optional[str] = None
+    # propagated trace context (obs/trace.TraceContext): this request's
+    # node in the distributed trace — None for untraced callers
+    trace: Optional[TraceContext] = None
 
 
 @dataclass
@@ -178,6 +182,10 @@ class SynthesisResult:
     # quality tier that served this result ("teacher-f32", "student-int8",
     # ...) — stamped by the tier's FleetRouter, surfaced as X-Model-Tier
     tier: Optional[str] = None
+    # the request's trace context, carried through so post-dispatch
+    # stages (streaming vocode windows, response tagging) can parent
+    # their spans without a side lookup
+    trace: Optional[TraceContext] = None
 
 
 def _fill_control(rows: List[Control], out: np.ndarray) -> np.ndarray:
@@ -907,6 +915,9 @@ class SynthesisEngine:
             )
         t_dispatch = time.monotonic()  # after any compile-on-miss: latency
         # histograms measure steady-state dispatch, not XLA
+        t_dispatch_wall = time.time()  # span timestamps must cross processes
+        acoustic_done_wall: Optional[float] = None
+        acoustic_done_mono: Optional[float] = None  # durations: monotonic
         b, l, t = bucket.b, bucket.l_src, bucket.t_mel
         n = len(requests)
 
@@ -977,7 +988,9 @@ class SynthesisEngine:
                 # back BEFORE vocoding
                 mel_host = np.asarray(mel_out)
                 synced = True
-                self._acoustic_hist.observe(time.monotonic() - t_dispatch)
+                acoustic_done_mono = time.monotonic()
+                self._acoustic_hist.observe(acoustic_done_mono - t_dispatch)
+                acoustic_done_wall = time.time()
                 wav_dev = self._vocoder_exe[(bucket.b, t)](params, mel_out)
                 # one vectorized int16 conversion for the whole batch
                 # (the per-item numpy work is what bounds coalesced
@@ -989,7 +1002,9 @@ class SynthesisEngine:
             else:
                 mel_host = np.asarray(mel_out)
                 synced = True
-                self._acoustic_hist.observe(time.monotonic() - t_dispatch)
+                acoustic_done_mono = time.monotonic()
+                self._acoustic_hist.observe(acoustic_done_mono - t_dispatch)
+                acoustic_done_wall = time.time()
         finally:
             # success path: the mel host sync proves the device is done
             # with the staging buffers. Exception path: the transfers may
@@ -1058,5 +1073,34 @@ class SynthesisEngine:
                 bucket=bucket,
                 batch_rows=n,
                 style_degraded=r.style_degraded,
+                trace=r.trace,
             ))
+        # one engine_run span per trace present in the coalesced batch
+        # (requests from different traces share the dispatch — each
+        # trace still shows where its device time went), with the
+        # acoustic/vocode split as children. Recorded after the fact so
+        # the hot path above stays untouched; Span.record no-ops when
+        # tracing is disarmed.
+        seen_traces = set()
+        for r in requests:
+            ctx = r.trace
+            if ctx is None or ctx.trace_id in seen_traces:
+                continue
+            seen_traces.add(ctx.trace_id)
+            eng_ctx = Span.record(
+                "engine_run", t_dispatch_wall, dur, parent=ctx,
+                bucket=dispatch_label, rows=n,
+            )
+            if eng_ctx is not None and acoustic_done_mono is not None:
+                acoustic_s = acoustic_done_mono - t_dispatch
+                Span.record(
+                    "engine_acoustic", t_dispatch_wall,
+                    acoustic_s, parent=eng_ctx,
+                )
+                if wavs is not None:
+                    Span.record(
+                        "engine_vocode", acoustic_done_wall,
+                        max(0.0, dur - acoustic_s),
+                        parent=eng_ctx,
+                    )
         return results

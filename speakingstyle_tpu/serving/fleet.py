@@ -59,6 +59,7 @@ import numpy as np
 
 from speakingstyle_tpu.faults import FaultPlan
 from speakingstyle_tpu.obs import JsonlEventLog, MetricsRegistry
+from speakingstyle_tpu.obs.trace import Span, TailSampler, get_span_ring
 from speakingstyle_tpu.serving import streaming
 from speakingstyle_tpu.serving.batcher import (
     DrainRateEstimator,
@@ -106,6 +107,12 @@ class _Pending:
     # replica-failure requeues survived so far (bounded by the class's
     # fleet.retry_budget)
     retries: int = field(compare=False, default=0)
+    # wall-clock submit stamp: the queue-wait span's start_ts (span
+    # timestamps must be wall clock — they cross processes); the
+    # monotonic twin measures the span's DURATION (JL009: wall deltas
+    # jump under NTP)
+    submit_wall: float = field(compare=False, default=0.0)
+    submit_mono: float = field(compare=False, default=0.0)
 
 
 class Replica:
@@ -201,6 +208,17 @@ class FleetRouter:
         self.model_version: Optional[str] = None
         self.model_step: Optional[int] = None
         self.model_digest: Optional[str] = None
+        # tail-sampling surface: interesting traces (shed / 504 / miss /
+        # hedge-won) are pinned into the process span ring the moment
+        # this router detects them; the trace id of the most recent such
+        # pressure signal also rides the autoscale event (the operator
+        # jumps from a scale decision to the trace that triggered it)
+        self._trace_ring = get_span_ring()
+        trace_cfg = getattr(serve, "trace", None)
+        self._tail_sampler = TailSampler(
+            trace_cfg.sample_rate if trace_cfg is not None else 0.1
+        )
+        self.last_pressure_trace_id: Optional[str] = None
 
         self._shed_ctr = self.registry.counter(
             "serve_shed_total",
@@ -513,6 +531,19 @@ class FleetRouter:
             return None
         return self._warmup_hist.percentile(0.50)
 
+    # -- tail sampling -------------------------------------------------------
+
+    def _note_pressure(self, ctx, reason: str) -> None:
+        """An interesting trace (shed / deadline / retry exhaustion /
+        hedge-won) was just detected: pin it into the span ring so it
+        survives ring churn, and remember its id as the latest pressure
+        signal — the autoscale event joins on it."""
+        if ctx is None:
+            return
+        if self._tail_sampler.keep(ctx.trace_id, reason):
+            self._trace_ring.pin(ctx.trace_id)
+        self.last_pressure_trace_id = ctx.trace_id
+
     # -- admission ----------------------------------------------------------
 
     def _admit(self, req: SynthesisRequest) -> str:
@@ -600,7 +631,21 @@ class FleetRouter:
             if self._closing:
                 self._rejected_ctr.inc()
                 raise ShutdownError("router is closed")
-            self._check_shed()
+            try:
+                self._check_shed()
+            except Overloaded:
+                # the classless serve_shed_total already counted inside
+                # _check_shed; this per-class family is what the SLO
+                # burn-rate engine differentiates (obs/slo.py)
+                self.registry.counter(
+                    "serve_class_shed_total", labels={"class": klass},
+                    help="submits shed by backpressure, per priority "
+                         "class (the SLO engine's bad-event stream)",
+                ).inc()
+                # a shed trace is always kept (tail-sampling keep rule)
+                self._note_pressure(
+                    getattr(request, "trace", None), "shed")
+                raise
             budget = self._budget_s(request, klass)
             self._seq += 1
             heapq.heappush(self._heap, _Pending(
@@ -610,6 +655,8 @@ class FleetRouter:
                 future=fut,
                 dispatch_by=request.arrival + self.max_wait,
                 klass=klass,
+                submit_wall=time.time(),
+                submit_mono=time.monotonic(),
             ))
             self._pending_gauge.set(len(self._heap))
             self.registry.counter(
@@ -693,10 +740,13 @@ class FleetRouter:
             help="requests resolved 504 instead of dispatched past their "
                  "class deadline budget",
         ).inc()
+        ctx = getattr(p.request, "trace", None)
+        self._note_pressure(ctx, "deadline_exceeded")
         if self.events is not None:
             self.events.emit(
                 "deadline_exceeded", req_id=p.request.id, klass=p.klass,
                 retries=p.retries,
+                trace_id=ctx.trace_id if ctx is not None else None,
             )
         budget = self._budget_s(p.request, p.klass) * 1e3
         # an expiry removes the entry from the heap for good — it drains
@@ -764,8 +814,18 @@ class FleetRouter:
         # jaxlint: disable=JL020 reason=stamped under _cond in _collect by this same single dispatch worker
         n = rep.dispatch_n
         t0 = time.monotonic()
+        t0_wall = time.time()
         for p in batch:
             self._queue_wait_hist.observe(t0 - p.request.arrival)
+            # the EDF wait is only known here, on the dispatch thread —
+            # record it after the fact under the request's context
+            ctx = getattr(p.request, "trace", None)
+            if ctx is not None and p.submit_wall:
+                Span.record(
+                    "serve_queue", p.submit_wall,
+                    max(0.0, t0 - p.submit_mono), parent=ctx,
+                    klass=p.klass, retries=p.retries,
+                )
         try:
             if self.fault_plan is not None:
                 if self.fault_plan.fire("replica_raise", n):
@@ -850,12 +910,24 @@ class FleetRouter:
                 if self.tier is not None:
                     r.tier = self.tier
                 self._latency_hist.observe(now - p.request.arrival)
+                ctx = getattr(p.request, "trace", None)
                 if now > p.slo_deadline:
                     self.registry.counter(
                         "serve_deadline_miss_total",
                         labels={"class": p.klass},
                         help="requests completed past their SLO deadline",
                     ).inc()
+                    self._note_pressure(ctx, "deadline_miss")
+                elif ctx is not None and \
+                        self._tail_sampler.keep(ctx.trace_id):
+                    # healthy traffic: deterministic sample-rate dice
+                    self._trace_ring.pin(ctx.trace_id)
+                if ctx is not None:
+                    Span.record(
+                        "fleet_dispatch", t0_wall,
+                        max(0.0, now - t0), parent=ctx,
+                        replica=rep.index, rows=len(batch),
+                    )
                 p.future.set_result(r)
         except BaseException as e:
             # bookkeeping bug AFTER a successful engine call: resolve the
@@ -957,12 +1029,30 @@ class FleetRouter:
                 failed=[p.request.id for p in exhausted],
                 expired=[p.request.id for p in expired],
                 backoff_s=round(max(0.0, backoff), 6),
+                trace_id=next(
+                    (p.request.trace.trace_id for p in batch
+                     if getattr(p.request, "trace", None) is not None),
+                    None,
+                ),
             )
+        # every requeued request gets a point-in-time span event so the
+        # assembled trace shows the failure → retry hop explicitly
+        now_wall = time.time()
+        for p in requeued:
+            ctx = getattr(p.request, "trace", None)
+            if ctx is not None:
+                Span.record(
+                    "fleet_requeue", now_wall, 0.0, parent=ctx,
+                    events=[{"name": "requeue", "ts": now_wall,
+                             "replica": rep.index, "kind": kind,
+                             "retry": p.retries}],
+                )
         for p in expired:
             self._resolve_deadline_exceeded(p)
         for p in shutdown:
             p.future.set_exception(ShutdownError("router closed"))
         for p in exhausted:
+            self._note_pressure(getattr(p.request, "trace", None), "error")
             p.future.set_exception(ReplicaError(
                 f"request {p.request.id!r} ({p.klass!r}) exhausted its "
                 f"retry budget after replica {rep.index} failed: "

@@ -87,6 +87,10 @@ class PendingRequest:
         self.stream = bool(stream)
         self.priority = priority
         self.arrival = time.monotonic() if arrival is None else arrival
+        # root TraceContext stamped by the HTTP handler; carried onto
+        # the resolved SynthesisRequest so every downstream stage's
+        # span lands in the same trace
+        self.trace = None
         self._future: Future = Future()
 
     def resolve(self, timeout: Optional[float] = RESOLVE_TIMEOUT_S):
@@ -185,7 +189,8 @@ class FrontendPool:
             self._depth_gauge.set(self._queue.qsize())
             try:
                 with Span("serve_frontend", registry=self.registry,
-                          events=self.events, req_id=item.id):
+                          events=self.events, parent=item.trace,
+                          req_id=item.id):
                     request = self.frontend.request(item.id, item.payload)
                     # the SLO clock and stream flag belong to the
                     # handler's admission instant, not to when a worker
@@ -193,6 +198,7 @@ class FrontendPool:
                     # matches inline mode
                     request.stream = item.stream
                     request.arrival = item.arrival
+                    request.trace = item.trace
             except BaseException as e:
                 self._errors_ctr.inc()
                 item._future.set_exception(e)

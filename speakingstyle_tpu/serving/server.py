@@ -94,6 +94,7 @@ import numpy as np
 
 from speakingstyle_tpu.configs.config import Config
 from speakingstyle_tpu.obs import JsonlEventLog, build_info, process_rss_bytes
+from speakingstyle_tpu.obs.trace import Span, assemble_trace, get_span_ring
 from speakingstyle_tpu.serving import streaming
 from speakingstyle_tpu.serving.batcher import (
     ContinuousBatcher,
@@ -433,12 +434,14 @@ class SynthesisServer:
         model_info: Optional[Dict] = None,  # single-engine identity
         # (fleet mode reads the router's set_model_version state instead)
         longform=None,  # LongformService; auto-built when a frontend exists
+        slo=None,  # obs.slo.SloEngine; /healthz grows a burn-rate block
     ):
         if engine is None and router is None:
             raise ValueError("SynthesisServer needs an engine or a router")
         self.engine = engine
         self.router = router
         self.lifecycle = lifecycle
+        self.slo = slo
         self._model_info = model_info
         self.cfg: Config = router.cfg if router is not None else engine.cfg
         serve = self.cfg.serve
@@ -542,13 +545,18 @@ class SynthesisServer:
                 pass
 
             def _json(self, code: int, obj: Dict, req_id: Optional[str] = None,
-                      headers: Optional[Dict[str, str]] = None):
+                      headers: Optional[Dict[str, str]] = None,
+                      trace_id: Optional[str] = None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
                 if req_id is not None:
                     self.send_header("X-Request-Id", req_id)
+                if trace_id is not None:
+                    # every error verdict joins its trace: grep the span
+                    # ring / event log by this id
+                    self.send_header("X-Trace-Id", trace_id)
                 for k, v in (headers or {}).items():
                     self.send_header(k, v)
                 self.end_headers()
@@ -575,9 +583,14 @@ class SynthesisServer:
                     if outer.batcher is not None:
                         outer.batcher.refresh_gauges()
                     outer.refresh_process_gauges()
+                    # cluster mode appends the fleet_* federation: every
+                    # live replica's counters summed and histogram
+                    # buckets MERGED (fleet p999 comes from merged
+                    # buckets, never from averaged percentiles)
                     return self._text(
                         200,
-                        outer.registry.prometheus_text(),
+                        outer.registry.prometheus_text()
+                        + outer.federated_text(),
                         "text/plain; version=0.0.4; charset=utf-8",
                     )
                 if self.path == "/debug/programs":
@@ -585,6 +598,21 @@ class SynthesisServer:
                         "programs": outer.programs(),
                         "build": outer.build,
                     })
+                if self.path.split("?")[0] == "/debug/spans":
+                    ring = get_span_ring()
+                    return self._json(200, {
+                        "spans": ring.spans(),
+                        "kept": {tid: ring.spans(tid)
+                                 for tid in ring.kept_trace_ids()},
+                        "stats": ring.stats(),
+                    })
+                if self.path.startswith("/debug/trace/"):
+                    tid = self.path[len("/debug/trace/"):].split("?")[0]
+                    if not tid:
+                        return self._json(400, {
+                            "error": "GET /debug/trace/<trace_id>"
+                        })
+                    return self._json(200, outer.trace_view(tid))
                 if self.path == "/styles":
                     if outer.style is None:
                         return self._json(400, {
@@ -711,6 +739,9 @@ class SynthesisServer:
                 # request's http_request/serve_dispatch records (and the
                 # X-Request-Id the client sees, errors included) all join
                 req_id = outer.next_req_id()
+                # the trace joins on req_id unless an upstream proxy
+                # already opened a trace and forwarded its id
+                trace_id = self.headers.get("X-Trace-Id") or req_id
                 t0 = time.monotonic()
                 status, err, headers = 200, None, None
                 extra_body = None
@@ -723,7 +754,8 @@ class SynthesisServer:
                             "(--griffin_lim serves mel JSON only)"
                         )
                     result = outer.synthesize(
-                        payload, req_id=req_id, stream=stream
+                        payload, req_id=req_id, stream=stream,
+                        trace_id=trace_id,
                     )
                 except RequestTooLarge as e:
                     # structured 413: the body states the admissible
@@ -760,14 +792,16 @@ class SynthesisServer:
                 except (TimeoutError, concurrent.futures.TimeoutError):
                     status, err = 504, "synthesis timed out"
                 if err is not None:
-                    outer._request_done(req_id, parsed.path, status, t0)
+                    outer._request_done(req_id, parsed.path, status, t0,
+                                        trace_id=trace_id)
                     body = {"error": err, "id": req_id}
                     if extra_body:
                         body.update(extra_body)
-                    return self._json(status, body,
-                                      req_id=req_id, headers=headers)
+                    return self._json(status, body, req_id=req_id,
+                                      headers=headers, trace_id=trace_id)
                 if stream:
-                    return self._stream_response(result, req_id, parsed, t0)
+                    return self._stream_response(result, req_id, parsed, t0,
+                                                 trace_id=trace_id)
                 extra_hdr = {}
                 if result.style_degraded:
                     extra_hdr["X-Style-Degraded"] = "1"
@@ -785,20 +819,23 @@ class SynthesisServer:
                 if result.wav is None:
                     # vocoder-less engine: return the mel as JSON
                     outer._request_done(req_id, parsed.path, 200, t0,
-                                        served_by=served_by)
+                                        served_by=served_by,
+                                        trace_id=trace_id)
                     return self._json(200, {
                         "id": result.id,
                         "mel_len": result.mel_len,
                         "mel": result.mel.tolist(),
-                    }, req_id=req_id, headers=extra_hdr or None)
+                    }, req_id=req_id, headers=extra_hdr or None,
+                        trace_id=trace_id)
                 sr = outer.cfg.preprocess.preprocessing.audio.sampling_rate
                 body = wav_bytes(result.wav, sr)
                 outer._request_done(req_id, parsed.path, 200, t0,
-                                    served_by=served_by)
+                                    served_by=served_by, trace_id=trace_id)
                 self.send_response(200)
                 self.send_header("Content-Type", "audio/wav")
                 self.send_header("Content-Length", str(len(body)))
                 self.send_header("X-Request-Id", result.id)
+                self.send_header("X-Trace-Id", trace_id)
                 self.send_header("X-Batch-Rows", str(result.batch_rows))
                 if result.style_degraded:
                     self.send_header("X-Style-Degraded", "1")
@@ -811,7 +848,8 @@ class SynthesisServer:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _stream_response(self, result, req_id, parsed, t0):
+            def _stream_response(self, result, req_id, parsed, t0,
+                                 trace_id=None):
                 """Chunked audio/wav: streaming RIFF header, then PCM in
                 overlap-trimmed windows as each is vocoded."""
                 sr = outer.cfg.preprocess.preprocessing.audio.sampling_rate
@@ -825,6 +863,8 @@ class SynthesisServer:
                 self.send_header("Content-Type", "audio/wav")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.send_header("X-Request-Id", result.id)
+                if trace_id is not None:
+                    self.send_header("X-Trace-Id", trace_id)
                 self.send_header("X-Batch-Rows", str(result.batch_rows))
                 if result.style_degraded:
                     self.send_header("X-Style-Degraded", "1")
@@ -844,20 +884,23 @@ class SynthesisServer:
                 except (BrokenPipeError, ConnectionResetError):
                     # client hung up mid-stream: stop vocoding for them
                     self.close_connection = True
-                    outer._request_done(req_id, parsed.path, 499, t0)
+                    outer._request_done(req_id, parsed.path, 499, t0,
+                                        trace_id=trace_id)
                     return
                 except Exception as e:
                     # headers are gone — the only honest signal is a
                     # truncated chunked body (no terminal chunk)
                     self.close_connection = True
-                    outer._request_done(req_id, parsed.path, 500, t0)
+                    outer._request_done(req_id, parsed.path, 500, t0,
+                                        trace_id=trace_id)
                     if outer.events is not None:
                         outer.events.emit(
                             "stream_abort", req_id=req_id,
                             error=type(e).__name__,
                         )
                     return
-                outer._request_done(req_id, parsed.path, 200, t0)
+                outer._request_done(req_id, parsed.path, 200, t0,
+                                    trace_id=trace_id)
 
             def _synthesize_longform(self, parsed):
                 """POST /synthesize/longform: chapter in, one chunked
@@ -868,6 +911,7 @@ class SynthesisServer:
                 error / an ``X-Longform-Tier`` header naming the tier
                 that actually produced the audio)."""
                 req_id = outer.next_req_id()
+                trace_id = self.headers.get("X-Trace-Id") or req_id
                 t0 = time.monotonic()
                 status, err, headers, extra_body = 200, None, None, None
                 try:
@@ -909,12 +953,13 @@ class SynthesisServer:
                 except (TimeoutError, concurrent.futures.TimeoutError):
                     status, err = 504, "long-form synthesis timed out"
                 if err is not None:
-                    outer._request_done(req_id, parsed.path, status, t0)
+                    outer._request_done(req_id, parsed.path, status, t0,
+                                        trace_id=trace_id)
                     body = {"error": err, "id": req_id}
                     if extra_body:
                         body.update(extra_body)
-                    return self._json(status, body,
-                                      req_id=req_id, headers=headers)
+                    return self._json(status, body, req_id=req_id,
+                                      headers=headers, trace_id=trace_id)
                 sr = outer.cfg.preprocess.preprocessing.audio.sampling_rate
 
                 def write_chunk(data: bytes):
@@ -950,20 +995,23 @@ class SynthesisServer:
                     self.wfile.write(b"0\r\n\r\n")
                 except (BrokenPipeError, ConnectionResetError):
                     self.close_connection = True
-                    outer._request_done(req_id, parsed.path, 499, t0)
+                    outer._request_done(req_id, parsed.path, 499, t0,
+                                        trace_id=trace_id)
                     return
                 except Exception as e:
                     # headers are gone — the only honest signal is a
                     # truncated chunked body (no terminal chunk)
                     self.close_connection = True
-                    outer._request_done(req_id, parsed.path, 500, t0)
+                    outer._request_done(req_id, parsed.path, 500, t0,
+                                        trace_id=trace_id)
                     if outer.events is not None:
                         outer.events.emit(
                             "stream_abort", req_id=req_id,
                             error=type(e).__name__,
                         )
                     return
-                outer._request_done(req_id, parsed.path, 200, t0)
+                outer._request_done(req_id, parsed.path, 200, t0,
+                                    trace_id=trace_id)
 
             def _profile(self, parsed):
                 if not outer.cfg.serve.debug_profile:
@@ -981,7 +1029,12 @@ class SynthesisServer:
                     return self._json(
                         400, {"error": "seconds must be in (0, 60]"}
                     )
+                # fan-out FIRST (the replica captures run off-thread),
+                # so the fleet's windows overlap the local one
+                fanout = outer.profile_fanout(seconds)
                 ok, out = outer.capture_profile(seconds)
+                if fanout is not None:
+                    out["replicas"] = fanout
                 return self._json(200 if ok else 409, out)
 
         self.httpd = ThreadingHTTPServer(
@@ -1033,24 +1086,34 @@ class SynthesisServer:
         return max(0.001, min(self.request_timeout, remaining))
 
     def synthesize(self, payload: Dict, req_id: Optional[str] = None,
-                   stream: bool = False):
+                   stream: bool = False, trace_id: Optional[str] = None):
         if req_id is None:
             req_id = self.next_req_id()
-        if self.frontend_pool is not None:
-            # pipelined path: admission sees a PendingRequest stand-in
-            # (id/arrival/priority/stream are known pre-G2P) while the
-            # frontend resolves on a pool worker under the coalescing
-            # wait. prepare -> submit -> dispatch ordering matters: a
-            # shed/shutdown refusal at submit wastes no frontend work
-            pending = self.frontend_pool.prepare(req_id, payload,
-                                                 stream=stream)
-            future = self.backend.submit(pending)
-            self.frontend_pool.dispatch(pending)
-            return future.result(timeout=self._result_timeout(pending))
-        request = self.frontend.request(req_id, payload)
-        request.stream = stream   # mel-only dispatch; windows vocode after
-        future = self.backend.submit(request)
-        return future.result(timeout=self._result_timeout(request))
+        # the ROOT span of the distributed trace: trace_id defaults to
+        # the req_id join key; every downstream stage (frontend, EDF
+        # queue, hedge legs, replica engine, vocode windows) parents
+        # under sp.ctx, which rides the request object
+        with Span("serve_request", trace_id=trace_id or req_id,
+                  req_id=req_id, stream=bool(stream)) as sp:
+            if self.frontend_pool is not None:
+                # pipelined path: admission sees a PendingRequest
+                # stand-in (id/arrival/priority/stream are known
+                # pre-G2P) while the frontend resolves on a pool worker
+                # under the coalescing wait. prepare -> submit ->
+                # dispatch ordering matters: a shed/shutdown refusal at
+                # submit wastes no frontend work
+                pending = self.frontend_pool.prepare(req_id, payload,
+                                                     stream=stream)
+                pending.trace = sp.ctx
+                future = self.backend.submit(pending)
+                self.frontend_pool.dispatch(pending)
+                return future.result(
+                    timeout=self._result_timeout(pending))
+            request = self.frontend.request(req_id, payload)
+            request.stream = stream   # mel-only; windows vocode after
+            request.trace = sp.ctx
+            future = self.backend.submit(request)
+            return future.result(timeout=self._result_timeout(request))
 
     # -- streaming ----------------------------------------------------------
 
@@ -1124,7 +1187,7 @@ class SynthesisServer:
 
     def _request_done(
         self, req_id: str, path: str, status: int, t0: float,
-        served_by: Optional[str] = None,
+        served_by: Optional[str] = None, trace_id: Optional[str] = None,
     ) -> None:
         dur = time.monotonic() - t0
         if status >= 400:
@@ -1142,6 +1205,8 @@ class SynthesisServer:
                 # req_id trail, so one grep follows a request from
                 # admission to the host that served it
                 fields["served_by"] = served_by
+            if trace_id:
+                fields["trace_id"] = trace_id
             self.events.emit("http_request", **fields)
 
     def model_info(self) -> Optional[Dict]:
@@ -1185,6 +1250,46 @@ class SynthesisServer:
         if precisions == ("f32",):
             return None
         return f"teacher-{precisions[0]}"
+
+    def trace_view(self, trace_id: str) -> Dict:
+        """GET /debug/trace/<id>: assemble one trace across processes —
+        the local span ring joined with every live replica's
+        (best-effort), stitched into a tree with the critical path
+        computed."""
+        ring = get_span_ring()
+        spans = {s["span_id"]: s for s in ring.spans(trace_id)
+                 if s.get("span_id")}
+        if self.router is not None \
+                and hasattr(self.router, "fetch_remote_spans"):
+            for s in self.router.fetch_remote_spans(trace_id):
+                spans.setdefault(s.get("span_id"), s)
+        return assemble_trace(list(spans.values()), trace_id)
+
+    def federated_text(self) -> str:
+        """The fleet_* Prometheus section (cluster mode only): the
+        router's federation cache merged into one registry."""
+        if self.router is None \
+                or not hasattr(self.router, "federated_registry"):
+            return ""
+        try:
+            return self.router.federated_registry().prometheus_text()
+        except Exception as e:
+            # a malformed scrape must never break /metrics — the local
+            # section still renders, and the failure itself is a metric
+            self.registry.counter(
+                "serve_federation_render_errors_total",
+                labels={"error": type(e).__name__},
+                help="federated /metrics sections dropped by error type",
+            ).inc()
+            return ""
+
+    def profile_fanout(self, seconds: float) -> Optional[Dict]:
+        """Trigger jax.profiler captures on every live replica process
+        (cluster mode); None when there is no fleet to fan out to."""
+        if self.router is None \
+                or not hasattr(self.router, "profile_fanout"):
+            return None
+        return self.router.profile_fanout(seconds)
 
     def refresh_process_gauges(self) -> None:
         """Sample process RSS + uptime into the registry (called at
@@ -1294,6 +1399,10 @@ class SynthesisServer:
                     for name in self.router.tiers()
                 },
             }
+        # SLO burn-rate block (obs/slo.py): per-class fast/slow window
+        # burn rates + whether the multi-window alert is firing
+        if self.slo is not None:
+            out["slo"] = self.slo.status()
         # present only when an Autoscaler is driving scale_to(): the
         # policy's last target plus its decision tally by reason
         if "serve_autoscale_target" in gauges:

@@ -43,10 +43,13 @@ ever emitted twice.
 """
 
 import math
+import time
 from collections import deque
 from typing import Iterator, Tuple
 
 import numpy as np
+
+from speakingstyle_tpu.obs.trace import Span
 
 __all__ = [
     "receptive_field_frames",
@@ -144,11 +147,23 @@ def stream_wav(
         raise ValueError(f"stream depth must be >= 1, got {depth}")
     hop = int(engine.vocoder[0].hop_factor)
     mel = result.mel
-    pending = deque()  # (handle, emit_start, emit_end, ctx_start)
+    # the request's trace context rides on the result: each window
+    # records one span covering its dispatch→collect life, so the
+    # assembled trace shows the depth-k pipeline's actual overlap
+    trace = getattr(result, "trace", None)
+    # (handle, emit_start, emit_end, ctx_start, t0_wall, t0_mono):
+    # wall stamp is the span's cross-process start_ts, the monotonic
+    # twin measures its duration (JL009)
+    pending = deque()
 
     def collect_one() -> np.ndarray:
-        handle, start, end, lo = pending.popleft()
+        handle, start, end, lo, t0, t0m = pending.popleft()
         wav = engine.vocode_collect(handle)
+        if trace is not None:
+            Span.record(
+                "vocode_window", t0, time.monotonic() - t0m, parent=trace,
+                frames=end - start,
+            )
         return wav[(start - lo) * hop: (end - lo) * hop]
 
     try:
@@ -156,7 +171,8 @@ def stream_wav(
             int(result.mel_len), window, overlap
         ):
             pending.append(
-                (engine.vocode_dispatch(mel[lo:hi]), start, end, lo)
+                (engine.vocode_dispatch(mel[lo:hi]), start, end, lo,
+                 time.time(), time.monotonic())
             )
             if len(pending) >= depth:
                 yield collect_one()

@@ -1374,6 +1374,70 @@ def test_jl025_tree_baseline_is_zero():
 
 
 # ---------------------------------------------------------------------------
+# JL026 — label-cardinality bombs at metric registration sites
+# ---------------------------------------------------------------------------
+
+
+def test_jl026_positive_each_bomb_shape():
+    # per-request identity in a label value (direct, attribute,
+    # f-string, subscript) and in a dynamic metric name
+    src = """
+        def handle(self, registry, req_id, payload, r):
+            registry.counter("serve_requests_total",
+                             labels={"req": req_id}).inc()
+            registry.gauge("serve_inflight",
+                           labels={"trace": r.trace_id}).set(1)
+            registry.histogram("serve_latency_seconds",
+                               labels={"who": f"{payload['text']}"})
+            registry.counter(f"serve_{req_id}_total").inc()
+    """
+    found = [
+        f for f in linter.lint_source(textwrap.dedent(src), _SERVING_PATH)
+        if f.rule == "JL026"
+    ]
+    assert len(found) == 4
+    details = " | ".join(f.detail for f in found)
+    assert "req_id" in details and "trace_id" in details
+    assert "the metric name" in details
+
+
+def test_jl026_negative_bounded_labels_and_other_receivers():
+    # bounded dynamic labels (class/replica/reason/bucket) are the
+    # sanctioned idiom; non-registry receivers and non-serving paths
+    # are out of scope
+    assert "JL026" not in _codes("""
+        def dispatch(self, registry, klass, rid, reason):
+            registry.counter("serve_class_requests_total",
+                             labels={"class": klass}).inc()
+            registry.gauge("serve_replica_busy",
+                           labels={"replica": rid}).set(1)
+            registry.counter("serve_autoscale_decisions_total",
+                             labels={"reason": reason}).inc()
+    """, path=_SERVING_PATH)
+    assert "JL026" not in _codes("""
+        def tally(self, counters, req_id):
+            counters.counter("x", labels={"req": req_id})
+    """, path=_SERVING_PATH)
+    assert "JL026" not in _codes("""
+        def tally(self, registry, req_id):
+            registry.counter("x", labels={"req": req_id})
+    """, path="speakingstyle_tpu/training/fake.py")
+
+
+def test_jl026_tree_baseline_is_zero():
+    """The bounded-cardinality claim, structurally: every metric label
+    in serving/ and obs/ is a bounded vocabulary — per-request identity
+    rides spans and events, so /metrics stays O(config), not
+    O(traffic)."""
+    findings = [f for f in linter.lint_paths() if f.rule == "JL026"]
+    assert findings == [], (
+        "JL026 must stay at zero tree findings — per-request identity "
+        f"goes on spans/events, not labels: "
+        f"{[f.fingerprint for f in findings]}"
+    )
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -1574,6 +1638,9 @@ def test_cli_check_exits_zero_on_repo():
               "    return HTTPConnection(host, 80)\n"),
     ("JL025", "import jax.numpy as jnp\n\ndef shrink(variables):\n"
               "    return variables.astype(jnp.bfloat16)\n"),
+    ("JL026", "def handle(registry, req_id):\n"
+              "    registry.counter(\"serve_requests_total\",\n"
+              "                     labels={\"req\": req_id}).inc()\n"),
 ])
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
     # JL004 is scoped to training/ paths; JL007 to speakingstyle_tpu/;
@@ -1581,7 +1648,7 @@ def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
     # speakingstyle_tpu/serving/; JL017 to both training/ and serving/
     # (training default suffices)
     sub = ("serving" if code in ("JL011", "JL012", "JL013", "JL015", "JL016",
-                                 "JL019", "JL024")
+                                 "JL019", "JL024", "JL026")
            else "training")
     d = tmp_path / "speakingstyle_tpu" / sub
     d.mkdir(parents=True)

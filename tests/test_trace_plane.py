@@ -1,0 +1,493 @@
+"""Fleet observability plane (tier-1).
+
+Six claims, mirroring the obs/trace.py + obs/slo.py +
+obs/registry.merge_states stack and its serving integration:
+
+  1. trace context propagates across the cluster wire: a hedged
+     router→replica dispatch assembles into ONE trace whose tree holds
+     router-side and replica-side spans, with a critical path;
+  2. hedge legs land as sibling spans under the request's context with
+     exactly one winner-marked leg;
+  3. the span ring is bounded (capacity + keep-store), and tail
+     sampling keeps every pressure trace while dicing healthy traffic
+     deterministically;
+  4. metrics federation merges histogram BUCKETS — fleet percentiles
+     equal a single registry over the union of observations, counters
+     sum, gauges stay replica-labeled, divergent edges degrade to a
+     labeled copy instead of corrupting the merge;
+  5. the SLO engine's multi-window burn-rate math against a synthetic
+     miss stream, with edge-triggered alert/resolve events;
+  6. the federation scraper survives a lease-expired replica — errors
+     are counted, the dead replica drops from the merged view, and the
+     fleet keeps serving.
+"""
+
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from speakingstyle_tpu.configs.config import (
+    ClusterConfig,
+    Config,
+    FleetConfig,
+    ServeConfig,
+    SloConfig,
+)
+from speakingstyle_tpu.faults import FaultPlan
+from speakingstyle_tpu.obs import MetricsRegistry
+from speakingstyle_tpu.obs import trace as obstrace
+from speakingstyle_tpu.obs.registry import merge_states
+from speakingstyle_tpu.obs.slo import SloEngine
+from speakingstyle_tpu.obs.trace import (
+    Span,
+    SpanRing,
+    TailSampler,
+    assemble_trace,
+    new_context,
+)
+from speakingstyle_tpu.serving.cluster import ClusterRouter, ReplicaServer
+from speakingstyle_tpu.serving.engine import SynthesisRequest
+
+# ---------------------------------------------------------------------------
+# harness (the test_cluster.py idiom: in-process replica "processes"
+# behind the subprocess surface, real HTTP in between)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(autouse=True)
+def _armed_ring():
+    """Every test here runs with recording armed and a fresh ring."""
+    was = obstrace.tracing_enabled()
+    obstrace.set_tracing_enabled(True)
+    obstrace.get_span_ring().clear()
+    yield
+    obstrace.get_span_ring().clear()
+    obstrace.set_tracing_enabled(was)
+
+
+def _req(i, L=8, T=4, **kw):
+    return SynthesisRequest(
+        id=f"q{i}", sequence=np.arange(1, L + 1, dtype=np.int32),
+        ref_mel=np.random.default_rng(i).standard_normal(
+            (T, 80)).astype(np.float32),
+        **kw,
+    )
+
+
+class _CountingEngine:
+    is_ready = True
+
+    def __init__(self):
+        self.runs = []
+        self.unstall = threading.Event()
+        self._lock = threading.Lock()
+
+    def precompile(self):
+        return 0.0
+
+    def run(self, requests):
+        with self._lock:
+            self.runs.extend(r.id for r in requests)
+        return [SimpleNamespace(id=r.id, mel_len=1) for r in requests]
+
+
+class _FakeProc:
+    def __init__(self, rid, router_addr, ccfg, engine=None):
+        self.engine = engine if engine is not None else _CountingEngine()
+        self.server = ReplicaServer(self.engine, rid, router_addr, ccfg)
+        self._rc = None
+        self.server.start()
+
+    def poll(self):
+        return self._rc
+
+    def terminate(self):
+        self._rc = 0
+        self.engine.unstall.set()
+        self.server.close()
+
+    kill = terminate
+
+    def wait(self, timeout=None):
+        return self._rc
+
+
+def _cfg(**cluster_kw):
+    ckw = dict(enabled=True, heartbeat_interval_s=0.1, lease_miss_budget=3,
+               spawn_grace_s=10.0, quorum=1, hedge_quantile=0.0)
+    ckw.update(cluster_kw)
+    return Config(serve=ServeConfig(
+        batch_buckets=[1], src_buckets=[16], mel_buckets=[64],
+        frames_per_phoneme=2, max_wait_ms=5.0,
+        fleet=FleetConfig(
+            queue_depth=64, stream_window=8,
+            rewarm_backoff_s=0.05, rewarm_backoff_max_s=0.5,
+            class_deadline_ms={"interactive": 10_000.0,
+                               "batch": 20_000.0},
+        ),
+        cluster=ClusterConfig(**ckw),
+    ))
+
+
+def _make_cluster(replicas, engine_factory=None, **cluster_kw):
+    cfg = _cfg(**cluster_kw)
+    procs = {}
+
+    def spawn(rid, router_addr, extra):
+        eng = engine_factory(rid) if engine_factory is not None else None
+        p = _FakeProc(rid, router_addr, cfg.serve.cluster, engine=eng)
+        procs[rid] = p
+        return p
+
+    reg = MetricsRegistry()
+    router = ClusterRouter(spawn, cfg, replicas=replicas, registry=reg,
+                           fault_plan=FaultPlan())
+    return router, procs, reg
+
+
+def _wait(pred, timeout=20.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _tree_names(view):
+    names = set()
+
+    def walk(node):
+        names.add(node["name"])
+        for child in node["children"]:
+            walk(child)
+
+    for root in view["roots"]:
+        walk(root)
+    return names
+
+
+# ---------------------------------------------------------------------------
+# 1. cross-process propagation
+# ---------------------------------------------------------------------------
+
+
+def test_trace_propagates_router_to_replica_and_assembles():
+    """One traced request through the cluster: the context rides the
+    wire (body + X-Trace-* headers), the replica's spans come back over
+    ``GET /debug/spans``, and the assembled tree holds BOTH sides of
+    the hop under one trace_id with a non-empty critical path."""
+    router, procs, reg = _make_cluster(replicas=1)
+    try:
+        assert router.wait_ready(timeout=20, n=1)
+        req = _req(1)
+        with Span("serve_request", trace_id="t-prop", req_id="q1") as sp:
+            req.trace = sp.ctx
+            assert router.submit(req).result(timeout=10) is not None
+        # leg records land on the leg threads after the response; wait
+        assert _wait(lambda: any(
+            s.get("name") == "replica_dispatch"
+            for s in router.fetch_remote_spans("t-prop")), 10)
+        spans = {s["span_id"]: s
+                 for s in obstrace.get_span_ring().spans("t-prop")}
+        for s in router.fetch_remote_spans("t-prop"):
+            spans.setdefault(s["span_id"], s)
+        assert all(s["trace_id"] == "t-prop" for s in spans.values())
+        view = assemble_trace(list(spans.values()), "t-prop")
+        names = _tree_names(view)
+        assert {"serve_request", "serve_queue", "fleet_dispatch",
+                "remote_dispatch", "replica_dispatch"} <= names
+        assert view["span_count"] == len(spans)
+        assert view["critical_path"], "a complete trace has a gating chain"
+        # the wire hop parents correctly: remote_dispatch is a child of
+        # the request context, replica_dispatch of the decoded context
+        by_name = {s["name"]: s for s in spans.values()}
+        assert by_name["remote_dispatch"]["parent_span_id"] \
+            == sp.ctx.span_id
+        assert by_name["replica_dispatch"]["parent_span_id"] \
+            == sp.ctx.span_id
+    finally:
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# 2. hedge legs: siblings, exactly one winner
+# ---------------------------------------------------------------------------
+
+
+def test_hedge_legs_are_siblings_with_exactly_one_winner():
+    stall_once = {"armed": True}
+    gate = threading.Lock()
+
+    class _SlowOnce(_CountingEngine):
+        def run(self, requests):
+            if any(r.id == "q500" for r in requests):
+                with gate:
+                    hit = stall_once["armed"]
+                    stall_once["armed"] = False
+                if hit:
+                    self.unstall.wait(timeout=5.0)
+            return super().run(requests)
+
+    router, procs, reg = _make_cluster(
+        replicas=2, engine_factory=lambda rid: _SlowOnce(),
+        hedge_quantile=0.95, hedge_min_ms=50.0, hedge_max_ms=150.0,
+    )
+    try:
+        assert router.wait_ready(timeout=20, n=2)
+        req = _req(500)   # id "q500": the one dispatch the stall arms on
+        req.trace = new_context("t-hedge")
+        assert router.submit(req).result(timeout=10) is not None
+        # release the stalled primary so its leg record can land too
+        for p in procs.values():
+            p.engine.unstall.set()
+
+        def legs():
+            return [s for s in obstrace.get_span_ring().spans("t-hedge")
+                    if s.get("name") == "remote_dispatch"]
+
+        assert _wait(lambda: len(legs()) == 2, 10)
+        got = legs()
+        # siblings: both legs are children of the SAME request context
+        assert {s["parent_span_id"] for s in got} \
+            == {req.trace.span_id}
+        assert {s["fields"]["hedge_leg"] for s in got} \
+            == {"primary", "hedge"}
+        winners = [s for s in got if s["fields"].get("winner")]
+        assert len(winners) == 1
+        assert winners[0]["fields"]["hedge_leg"] == "hedge"
+        # hedge-won is a tail-sampling keep reason: the trace is pinned
+        assert "t-hedge" in obstrace.get_span_ring().kept_trace_ids()
+        assert router.last_pressure_trace_id == "t-hedge"
+    finally:
+        for p in procs.values():
+            p.engine.unstall.set()
+        router.close()
+
+
+# ---------------------------------------------------------------------------
+# 3. ring bounds + tail-sampling keep rules
+# ---------------------------------------------------------------------------
+
+
+def _rec(i, tid=None):
+    return {"name": "s", "trace_id": tid or f"t{i}", "span_id": f"s{i}",
+            "start_ts": float(i), "duration_s": 0.0}
+
+
+def test_span_ring_is_bounded_and_pin_survives_churn():
+    ring = SpanRing(capacity=8, keep_traces=2)
+    for i in range(20):
+        ring.add(_rec(i))
+    stats = ring.stats()
+    assert stats["spans"] == 8 and stats["capacity"] == 8
+    assert stats["evictions"] == 12
+    # pin, then churn the ring far past capacity: the kept trace's
+    # spans survive, and later spans of the same trace keep attaching
+    ring.add(_rec(100, tid="keep"))
+    ring.pin("keep")
+    for i in range(200, 240):
+        ring.add(_rec(i))
+    ring.add(_rec(101, tid="keep"))
+    assert [s["span_id"] for s in ring.spans("keep")] == ["s100", "s101"]
+    assert ring.last_pinned_trace_id == "keep"
+    # the keep-store is bounded too: a third pin evicts the oldest
+    ring.pin("k2")
+    ring.pin("k3")
+    assert ring.kept_trace_ids() == ["k2", "k3"]
+    ring.clear()
+    assert ring.stats() == {"spans": 0, "capacity": 8, "kept_traces": 0,
+                            "evictions": 0}
+
+
+def test_tail_sampler_keeps_every_pressure_trace():
+    s = TailSampler(sample_rate=0.0)
+    for reason in TailSampler.KEEP_REASONS:
+        assert s.keep(f"t-{reason}", reason=reason)
+    # healthy traffic at rate 0: never kept; at rate 1: always kept
+    assert not s.keep("healthy-1")
+    assert TailSampler(sample_rate=1.0).keep("healthy-1")
+    # the dice are deterministic per trace id, so router and replica
+    # (separate sampler instances) agree on which healthy traces to pin
+    ids = [f"r{i}" for i in range(200)]
+    a, b = TailSampler(0.5), TailSampler(0.5)
+    picks = [a.keep(t) for t in ids]
+    assert picks == [b.keep(t) for t in ids]
+    assert 0 < sum(picks) < len(ids)   # the rate actually subsamples
+    with pytest.raises(ValueError):
+        TailSampler(sample_rate=1.5)
+
+
+# ---------------------------------------------------------------------------
+# 4. federation: bucket merge, not percentile averaging
+# ---------------------------------------------------------------------------
+
+
+def test_merge_states_bucket_merge_matches_single_registry():
+    edges = (0.01, 0.1, 1.0)
+    a, b, single = MetricsRegistry(), MetricsRegistry(), MetricsRegistry()
+    obs_a = [0.005] * 40 + [0.5] * 2
+    obs_b = [0.05] * 30 + [2.0] * 8
+    for reg_i, values in ((a, obs_a), (b, obs_b)):
+        for v in values:
+            reg_i.histogram("serve_latency_seconds", edges=edges).observe(v)
+            single.histogram("serve_latency_seconds", edges=edges).observe(v)
+    a.counter("serve_requests_total").inc(5)
+    b.counter("serve_requests_total").inc(7)
+    a.gauge("serve_inflight").set(2)
+    b.gauge("serve_inflight").set(3)
+
+    merged = merge_states([("r0", a.export_state()),
+                           ("r1", b.export_state())])
+    # counters: summed under one fleet_ identity
+    assert merged.value("fleet_serve_requests_total") == 12
+    # gauges: levels stay per-replica
+    assert merged.value("fleet_serve_inflight", {"replica": "r0"}) == 2
+    assert merged.value("fleet_serve_inflight", {"replica": "r1"}) == 3
+    # histograms: the merged buckets answer percentiles EXACTLY as a
+    # single registry over the union of observations would — the
+    # never-average-percentiles invariant (averaging the two replicas'
+    # p999s here would land near 1.25s; the fleet p999 is above 2s
+    # because replica b's tail dominates)
+    mh = merged.metrics_named("fleet_serve_latency_seconds")[0]
+    sh = single.metrics_named("serve_latency_seconds")[0]
+    for q in (0.5, 0.99, 0.999):
+        assert mh.percentile(q) == sh.percentile(q)
+    # a replica with divergent edges (config skew mid-rollout) degrades
+    # to a replica-labeled copy instead of corrupting the merge
+    c = MetricsRegistry()
+    c.histogram("serve_latency_seconds", edges=(1.0, 2.0)).observe(1.5)
+    merged2 = merge_states([("r0", a.export_state()),
+                            ("rX", c.export_state())])
+    labeled = [
+        rec for rec in merged2.export_state()["metrics"]
+        if rec["name"] == "fleet_serve_latency_seconds"
+        and ["replica", "rX"] in [list(kv) for kv in rec["labels"]]
+    ]
+    assert labeled, "divergent-edge replica must keep a labeled copy"
+
+
+# ---------------------------------------------------------------------------
+# 5. SLO burn-rate window math
+# ---------------------------------------------------------------------------
+
+
+class _EventSink:
+    def __init__(self):
+        self.records = []
+
+    def emit(self, event, **fields):
+        self.records.append(dict(fields, event=event))
+
+
+def test_slo_engine_burn_rate_windows_and_edge_trigger():
+    reg = MetricsRegistry()
+    scfg = SloConfig(
+        objectives={"interactive": 0.999}, fast_window_s=60.0,
+        slow_window_s=600.0, fast_burn_threshold=14.4,
+        slow_burn_threshold=6.0, tick_s=5.0,
+    )
+    events = _EventSink()
+    ring = SpanRing(capacity=16, keep_traces=4)
+    ring.add(_rec(1, tid="t-bad"))
+    ring.pin("t-bad")
+    eng = SloEngine(reg, scfg, events=events, trace_ring=ring, start=False)
+    req = reg.counter("serve_class_requests_total",
+                      labels={"class": "interactive"})
+    miss = reg.counter("serve_deadline_miss_total",
+                       labels={"class": "interactive"})
+    t0 = 1000.0
+    req.inc(1000)
+    assert eng.step(now=t0) == {"interactive": False}
+    assert eng.burn_rate("interactive", "fast") == 0.0
+
+    # 20 misses over 1000 requests against a 99.9% objective:
+    # burn = (20/1000) / 0.001 = 20 — past both thresholds
+    req.inc(1000)
+    miss.inc(20)
+    assert eng.step(now=t0 + 30.0) == {"interactive": True}
+    assert eng.burn_rate("interactive", "fast") == pytest.approx(20.0)
+    assert reg.value("serve_slo_burn_rate",
+                     {"class": "interactive", "window": "fast"}) \
+        == pytest.approx(20.0)
+    assert reg.value("serve_slo_alerts_total",
+                     {"class": "interactive"}) == 1
+    alert = events.records[-1]
+    assert alert["event"] == "slo_alert"
+    assert alert["klass"] == "interactive"
+    assert alert["fast_burn"] == pytest.approx(20.0)
+    assert alert["trace_id"] == "t-bad"   # jump-to-trace handle
+
+    # sustained burn: still alerting, but edge-triggered — no re-emit
+    assert eng.step(now=t0 + 35.0) == {"interactive": True}
+    assert len([r for r in events.records
+                if r["event"] == "slo_alert"]) == 1
+
+    # clean traffic pushes the bad sample past BOTH windows: resolved
+    req.inc(50_000)
+    eng.step(now=t0 + 400.0)
+    assert eng.step(now=t0 + 700.0) == {"interactive": False}
+    assert events.records[-1]["event"] == "slo_resolved"
+    status = eng.status()["interactive"]
+    assert status["objective"] == 0.999
+    assert status["alerting"] is False
+    assert status["fast_burn"] == 0.0
+
+
+def test_slo_engine_shed_counts_in_numerator_and_denominator():
+    # a shed request never reached serve_class_requests_total — the
+    # engine must widen the denominator by the shed count, or burn
+    # overshoots
+    reg = MetricsRegistry()
+    scfg = SloConfig(objectives={"batch": 0.99}, fast_window_s=60.0,
+                     slow_window_s=600.0, fast_burn_threshold=14.4,
+                     slow_burn_threshold=6.0, tick_s=5.0)
+    eng = SloEngine(reg, scfg, start=False)
+    eng.step(now=0.0)
+    reg.counter("serve_class_requests_total",
+                labels={"class": "batch"}).inc(90)
+    reg.counter("serve_class_shed_total", labels={"class": "batch"}).inc(10)
+    eng.step(now=30.0)
+    # bad=10 over total=100 against a 1% budget -> burn 10
+    assert eng.burn_rate("batch", "fast") == pytest.approx(10.0)
+
+
+# ---------------------------------------------------------------------------
+# 6. federation survives a lease-expired replica
+# ---------------------------------------------------------------------------
+
+
+def test_federation_scrape_survives_lease_expired_replica():
+    router, procs, reg = _make_cluster(replicas=2)
+    try:
+        assert router.wait_ready(timeout=20, n=2)
+        assert _wait(lambda: len(router.federated_states()) == 2, 10)
+        assert router.submit(_req(7)).result(timeout=10) is not None
+        text = router.federated_registry().prometheus_text()
+        assert "fleet_serve_wire_dispatches_total" in text
+
+        # silence one replica WITHOUT marking its process dead: its
+        # heartbeats stop, the lease expires, and its /metrics endpoint
+        # answers nothing — the scraper must neither crash nor keep the
+        # frozen state in the merged view
+        victim = sorted(procs)[0]
+        procs[victim].engine.unstall.set()
+        procs[victim].server.close()
+        assert _wait(
+            lambda: all(rid != victim
+                        for rid, _ in router.federated_states()), 20,
+        ), "expired replica must drop out of the federation cache"
+        # the scrape loop is still alive and the merge still renders
+        scrapes = reg.value("serve_federation_scrapes_total")
+        assert _wait(
+            lambda: reg.value("serve_federation_scrapes_total") > scrapes,
+            10,
+        )
+        assert "fleet_" in router.federated_registry().prometheus_text()
+        # and the fleet still serves through the survivor
+        assert router.submit(_req(9)).result(timeout=15) is not None
+    finally:
+        router.close()
