@@ -1229,8 +1229,8 @@ class ProxyDeviceEngine:
     # dispatch/collect, not vocode_window: the device floor rides the
     # collect (the sync point), so in-flight windows still overlap the
     # host side exactly as a real device would
-    def vocode_dispatch(self, mel):
-        return self._inner.vocode_dispatch(mel)
+    def vocode_dispatch(self, mel, klass=None, trace=None):
+        return self._inner.vocode_dispatch(mel, klass=klass, trace=trace)
 
     def vocode_collect(self, handle):
         wav = self._inner.vocode_collect(handle)
@@ -2410,6 +2410,347 @@ def run_trace(duration: float = 3.0, clients: int = 16,
         "stage_p999_ms": {k: pctl_ms(v, 99.9)
                           for k, v in sorted(stage.items())},
         "stage_n": {k: len(v) for k, v in sorted(stage.items())},
+        **_lock_witness_stats(),
+    })
+    print(json.dumps(point))
+    return point
+
+
+def run_quality(duration: float = 3.0, clients: int = 16,
+                device_ms: float = 20.0):
+    """Quality-plane drill: price the validators, then prove the plane
+    actually pages when a tier starts shipping garbage.
+
+    ONE 2-replica CPU-proxy fleet (the run_chaos setup) runs three
+    phases:
+
+      A  paired validator-overhead ablation — every closed-loop client
+         alternates ``quality_check`` on/off per adjacent same-class
+         pair (the run_trace pairing: which arm goes first flips with
+         client parity), so the median paired diff prices exactly what
+         the choke point (obs/quality.py) adds to a request. Gated at
+         <= 2% of the unchecked p50 in run_compare.
+      B  healthy phase — tenant load with validators armed, golden
+         anchors pinned (serving/probes.py) and probe rounds + SLO
+         steps (synthetic clock) interleaved: the invariant is ZERO
+         quality pages while the fleet is healthy (false_pages).
+      C  degradation drill — quiesced, ``tier_poison`` armed on the
+         next dispatch corrupts ONE replica's param tree in place
+         (same shapes/dtypes: zero compiles, no errors, just garbage
+         audio). Traced tenant load makes the validators fail and pin
+         exemplar traces; probe rounds + SLO steps run until BOTH the
+         probe drift edge and the quality burn-rate alert fire. The
+         drill records how many probe rounds detection took
+         (``probes_to_detection``, budget 16) and the exemplar trace
+         id the page carries.
+
+    Closed-loop clients await every submission across all phases, so
+    ``lost_requests`` is exact; a CompileMonitor spans A-C (the poison
+    is a host-side re-put — steady state must stay at zero compiles).
+    ``missed_detection``, ``false_pages``, ``lost_requests``, and the
+    overhead budget all carry hard gates in run_compare.
+    """
+    import dataclasses
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    import jax
+
+    from speakingstyle_tpu.configs.config import FleetConfig
+    from speakingstyle_tpu.faults import FaultPlan
+    from speakingstyle_tpu.models.factory import build_model, init_variables
+    from speakingstyle_tpu.models.hifigan import Generator
+    from speakingstyle_tpu.obs import JsonlEventLog, MetricsRegistry
+    from speakingstyle_tpu.obs import trace as obstrace
+    from speakingstyle_tpu.obs.events import read_events
+    from speakingstyle_tpu.obs.slo import SloEngine
+    from speakingstyle_tpu.obs.trace import Span
+    from speakingstyle_tpu.serving.batcher import Overloaded
+    from speakingstyle_tpu.serving.engine import (
+        CompileMonitor,
+        SynthesisEngine,
+        SynthesisRequest,
+    )
+    from speakingstyle_tpu.serving.fleet import FleetRouter
+    from speakingstyle_tpu.serving.probes import GoldenProber
+    from speakingstyle_tpu.serving.style import StyleService
+
+    PROBE_BUDGET = 16  # probe rounds the degradation may take to page
+
+    label = "tiny-cpu-proxydev"
+    _mark("building quality fleet parts")
+    cfg = _fleet_proxy_config()
+    # the chaos drill's generous deadlines: this drill measures the
+    # quality plane, so scheduling-induced expiry must not show up as
+    # loss or pollute the (latency) SLO stream
+    cfg = dataclasses.replace(cfg, serve=dataclasses.replace(
+        cfg.serve, fleet=FleetConfig(
+            stream_window=8, queue_depth=256,
+            class_deadline_ms={"interactive": 30_000.0, "batch": 60_000.0},
+            rewarm_backoff_s=0.2, rewarm_backoff_max_s=5.0,
+        ),
+    ))
+    serve = cfg.serve
+    scfg = serve.slo
+    n_position = max(serve.mel_buckets[-1], serve.src_buckets[-1],
+                     cfg.model.max_seq_len) + 1
+    model = build_model(cfg, n_position=n_position)
+    variables = init_variables(model, cfg, jax.random.PRNGKey(0))
+    gen = Generator(
+        upsample_rates=(2, 2), upsample_kernel_sizes=(4, 4),
+        upsample_initial_channel=16, resblock_kernel_sizes=(3,),
+        resblock_dilation_sizes=((1,),),
+    )
+    n_mels = cfg.preprocess.preprocessing.mel.n_mel_channels
+    gparams = gen.init(
+        jax.random.PRNGKey(0), np.zeros((1, 8, n_mels), np.float32)
+    )["params"]
+    rng = np.random.default_rng(0)
+    max_len = min(serve.src_buckets[-1],
+                  serve.mel_buckets[-1] // serve.frames_per_phoneme)
+    max_ref = serve.style.ref_buckets[-1]
+    hot_refs = [
+        rng.standard_normal(
+            (int(rng.integers(8, max_ref + 1)), n_mels)
+        ).astype(np.float32)
+        for _ in range(8)
+    ]
+
+    def make_request(i: int, priority: str,
+                     check: bool = True) -> SynthesisRequest:
+        L = int(rng.integers(max(4, max_len // 2), max_len + 1))
+        return SynthesisRequest(
+            id=f"quality{i}",
+            sequence=rng.integers(1, 300, L).astype(np.int32),
+            ref_mel=hot_refs[i % len(hot_refs)],
+            priority=priority,
+            quality_check=check,
+        )
+
+    tmp = tempfile.mkdtemp(prefix="bench_quality_")
+    registry = MetricsRegistry()
+    plan = FaultPlan()
+    events = JsonlEventLog(tmp)
+    shared_style = StyleService(cfg, variables, registry=registry)
+
+    def factory(reg):
+        return ProxyDeviceEngine(
+            SynthesisEngine(
+                cfg, variables, vocoder=(gen, gparams), model=model,
+                registry=reg, style=shared_style,
+            ),
+            device_ms,
+        )
+
+    def pctl_ms(vals, q):
+        if not vals:
+            return None
+        return round(1e3 * float(np.percentile(vals, q)), 3)
+
+    point = {
+        "metric": "serve_quality", "replicas": 2, "clients": clients,
+        "probe_budget": PROBE_BUDGET, "proxy_device_ms": device_ms,
+        "model": label,
+        "unit": "ms closed-loop request latency (TTFA proxy on cpu)",
+    }
+    tally = dict(ok=0, shed=0, lost=0, errors=set())
+
+    def load_phase(phase_s: float, seed: int, paired: bool = False,
+                   traced: bool = False):
+        """Closed-loop load; every submission awaited. ``paired`` runs
+        the quality_check on/off A/B (run_trace pairing); ``traced``
+        gives every request the front door's root span so a failing
+        wav has a trace to pin. Merges into ``tally`` and returns the
+        phase summary."""
+        stop_at = time.perf_counter() + phase_s
+        per = [dict(ok=0, shed=0, lost=0, errors=[])
+               for _ in range(clients)]
+        lats = [([], []) for _ in range(clients)]  # (unchecked, checked)
+        diffs = [[] for _ in range(clients)]
+
+        def client(cid: int):
+            c, i = per[cid], 0
+            prev = None  # (index, checked, latency) of last success
+            while time.perf_counter() < stop_at:
+                prio = ("interactive"
+                        if ((i // 2) + cid) % 2 == 0 else "batch")
+                checked = True if not paired else (cid + i) % 2 == 0
+                req = make_request(seed + cid * 1_000_000 + i, prio,
+                                   check=checked)
+                t0 = time.perf_counter()
+                try:
+                    if traced:
+                        with Span("serve_request", trace_id=req.id,
+                                  req_id=req.id, klass=prio) as sp:
+                            req.trace = sp.ctx
+                            router.submit(req).result(timeout=120)
+                    else:
+                        router.submit(req).result(timeout=120)
+                    c["ok"] += 1
+                    lat = time.perf_counter() - t0
+                    if paired:
+                        lats[cid][int(checked)].append(lat)
+                        if i % 2 == 1 and prev is not None \
+                                and prev[0] == i - 1:
+                            d = (lat - prev[2]) if checked \
+                                else (prev[2] - lat)
+                            diffs[cid].append(d)  # checked - unchecked
+                        prev = (i, checked, lat)
+                except Overloaded:
+                    c["shed"] += 1
+                    prev = None
+                    time.sleep(0.002)
+                except Exception as e:
+                    c["lost"] += 1
+                    c["errors"].append(type(e).__name__)
+                    prev = None
+                i += 1
+
+        threads = [threading.Thread(target=client, args=(c,), daemon=True)
+                   for c in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        out = {k: sum(c[k] for c in per) for k in ("ok", "shed", "lost")}
+        out["qps"] = out["ok"] / dt
+        out["lat_off"] = [v for g in lats for v in g[0]]
+        out["lat_on"] = [v for g in lats for v in g[1]]
+        out["diffs"] = [v for g in diffs for v in g]
+        for k in ("ok", "shed", "lost"):
+            tally[k] += out[k]
+        tally["errors"] |= {e for c in per for e in c["errors"]}
+        return out
+
+    def quality_pages():
+        """Cumulative quality-page count: probe drift edges (any tier
+        label, 'style' included) + quality burn-rate alerts per class."""
+        n = 0.0
+        for t in ("default", "style"):
+            n += registry.value("serve_probe_drift_alerts_total",
+                                {"tier": t})
+        for klass in scfg.quality_objectives:
+            n += registry.value("serve_slo_quality_alerts_total",
+                                {"class": klass})
+        return int(n)
+
+    _mark("warming 2 quality replicas")
+    # ring BEFORE the router: configure_span_ring REPLACES the process
+    # ring, and the fleet binds its gates to whatever ring exists at
+    # construction — the SLO engine must read the same one to carry
+    # the pinned exemplar trace id on its page
+    prev_enabled = obstrace.tracing_enabled()
+    obstrace.configure_span_ring(16384, keep_traces=256)
+    obstrace.set_tracing_enabled(True)
+    router = FleetRouter(factory, cfg, replicas=2, registry=registry,
+                         style=shared_style, fault_plan=plan,
+                         events=events)
+    prober = slo = None
+    try:
+        if not router.wait_ready(timeout=600, n=2):
+            point["error"] = "replicas never became ready"
+            print(json.dumps(point))
+            return point
+        for engine in router.engines():
+            for b in engine.lattice.batch_buckets:
+                engine.run([make_request(10_000_000 + b * 100 + j, "batch")
+                            for j in range(b)])
+        _mark("quality warmup load")
+        load_phase(min(1.0, duration), 777, paired=True)
+        _mark("pinning golden anchors from the healthy fleet")
+        prober = GoldenProber(
+            router, cfg, style=shared_style, registry=registry,
+            events=events, anchor_dir=os.path.join(tmp, "anchors"),
+            start=False,
+        )
+        prober.pin()
+        prober.probe_once()  # warm the probe path before monitoring
+        # synthetic SLO clock (the slo-engine test idiom): one tick per
+        # activity burst, fast-window spaced, so both windows see the
+        # drill's counters without waiting wall-clock minutes
+        slo = SloEngine(registry, scfg, events=events,
+                        trace_ring=obstrace.get_span_ring(), start=False)
+        now = 0.0
+        slo.step(now=now)
+
+        with CompileMonitor() as qmon:
+            _mark("quality phase A: paired validator-overhead ablation")
+            overhead = load_phase(duration, 0, paired=True)
+            _mark("quality phase B: healthy probes under load")
+            healthy = load_phase(duration, 100_000_000, traced=True)
+            for _ in range(2):
+                prober.probe_once()
+                now += scfg.fast_window_s / 2
+                slo.step(now=now)
+            false_pages = quality_pages()
+
+            # quiesced (every phase-B submission resolved): the armed
+            # counter deterministically poisons the NEXT dispatch
+            plan.arm("tier_poison", router.dispatch_total + 1)
+            _mark("quality phase C: tier_poison degradation drill")
+            degraded = load_phase(duration, 200_000_000, traced=True)
+            probes_to_detection = None
+            for rounds in range(1, PROBE_BUDGET + 1):
+                summary = prober.probe_once()
+                now += scfg.fast_window_s / 2
+                slo.step(now=now)
+                if any(prober.alerting().values()) \
+                        and any(slo.quality_alerting().values()):
+                    probes_to_detection = rounds
+                    break
+        steady_compiles = qmon.count
+    finally:
+        obstrace.set_tracing_enabled(prev_enabled)
+        router.close()
+        if slo is not None:
+            slo.close()
+        if prober is not None:
+            prober.close()
+
+    detected = probes_to_detection is not None
+    paged_trace_id = None
+    validator_fails = 0
+    for rec in read_events(tmp):
+        if rec.get("event") == "quality_fail":
+            validator_fails += 1
+        elif rec.get("event") == "slo_quality_alert" \
+                and rec.get("trace_id"):
+            paged_trace_id = rec["trace_id"]
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    off_p50 = pctl_ms(overhead["lat_off"], 50)
+    med_diff_ms = pctl_ms(overhead["diffs"], 50)
+    worst_drift = max(
+        [0.0] + [s["mel_drift"] for s in summary["tiers"].values()]
+    ) if detected else None
+    point.update({
+        "unchecked_ttfa_p50_ms": off_p50,
+        "checked_ttfa_p50_ms": pctl_ms(overhead["lat_on"], 50),
+        "paired_diff_p50_ms": med_diff_ms,
+        "paired_diffs": len(overhead["diffs"]),
+        "overhead_ttfa_p50_pct": (
+            round(100.0 * med_diff_ms / off_p50, 2)
+            if off_p50 and med_diff_ms is not None else None
+        ),
+        "qps": round((healthy["qps"] + degraded["qps"]) / 2, 2),
+        "false_pages": false_pages,
+        "detected": detected,
+        "missed_detection": 0 if detected else 1,
+        "probes_to_detection": probes_to_detection,
+        "detection_mel_drift": (
+            worst_drift if worst_drift is None
+            or np.isfinite(worst_drift) else "inf"
+        ),
+        "paged_trace_id": paged_trace_id,
+        "validator_fails": validator_fails,
+        "lost_requests": tally["lost"],
+        "shed": tally["shed"],
+        "errors": sorted(tally["errors"]),
+        "steady_compiles": steady_compiles,
         **_lock_witness_stats(),
     })
     print(json.dumps(point))
@@ -4122,6 +4463,31 @@ def _absorb_record(rec, metrics):
         ):
             if isinstance(rec.get(src), (int, float)):
                 metrics[dst] = (float(rec[src]), "higher")
+    elif m == "serve_quality":
+        # the quality-plane drill; missed_detection, false_pages,
+        # lost_requests, and the validator overhead budget all carry
+        # hard gates in run_compare — a quality plane that misses a
+        # poisoned tier, pages a healthy fleet, drops work, or taxes
+        # the hot path >2% does not ship. As with serve_trace, only
+        # the budget excess (0 when passing) rides the relative diff;
+        # the signed overhead stays in the emitted point
+        if isinstance(rec.get("overhead_ttfa_p50_pct"), (int, float)):
+            metrics["quality_overhead_over_budget_pct"] = (
+                max(0.0, float(rec["overhead_ttfa_p50_pct"]) - 2.0),
+                "lower")
+        for src, dst in (
+            ("missed_detection", "quality_missed_detection"),
+            ("false_pages", "quality_false_pages"),
+            ("probes_to_detection", "quality_probes_to_detection"),
+            ("lost_requests", "quality_lost_requests"),
+            ("steady_compiles", "quality_steady_compiles"),
+            ("unchecked_ttfa_p50_ms", "quality_off_ttfa_p50_ms"),
+            ("checked_ttfa_p50_ms", "quality_on_ttfa_p50_ms"),
+        ):
+            if isinstance(rec.get(src), (int, float)):
+                metrics[dst] = (float(rec[src]), "lower")
+        if isinstance(rec.get("qps"), (int, float)):
+            metrics["quality_qps"] = (float(rec["qps"]), "higher")
     elif m == "serve_rollout":
         # the live-upgrade drill; rollout_lost_requests carries the same
         # hard zero gate as chaos/traffic in run_compare — an upgrade
@@ -4336,6 +4702,37 @@ def run_compare(old_path, new_path=None, threshold=REGRESSION_THRESHOLD,
               "span recording must stay off the request hot path",
               file=out)
         return 1
+    # quality-plane hard gates: a missed detection means the validators
+    # + golden probes let a poisoned tier ship garbage unpaged; a false
+    # page means the plane cries wolf on a healthy fleet; both are
+    # correctness bits, not percentages
+    miss = new.get("quality_missed_detection")
+    if miss is not None and miss[0] > 0:
+        print(f"FAIL: quality drill missed the injected tier "
+              f"degradation in {os.path.basename(new_path)}; the probe "
+              "drift edge and the quality burn-rate alert must both "
+              "fire within the probe budget", file=out)
+        return 1
+    fp = new.get("quality_false_pages")
+    if fp is not None and fp[0] > 0:
+        print(f"FAIL: quality drill paged {int(fp[0])} time(s) on the "
+              f"HEALTHY fleet in {os.path.basename(new_path)}; validator "
+              "thresholds and probe tolerances must hold quiet on good "
+              "audio", file=out)
+        return 1
+    lost = new.get("quality_lost_requests")
+    if lost is not None and lost[0] > 0:
+        print(f"FAIL: quality drill lost {int(lost[0])} request(s) in "
+              f"{os.path.basename(new_path)}; validators observe and "
+              "account — they must never drop work", file=out)
+        return 1
+    ov = new.get("quality_overhead_over_budget_pct")
+    if ov is not None and ov[0] > 0:
+        print(f"FAIL: validator overhead {ov[0] + 2.0:.2f}% on TTFA p50 "
+              f"in {os.path.basename(new_path)} exceeds the 2% budget; "
+              "the quality choke point must stay cheap enough for every "
+              "wav", file=out)
+        return 1
     # quality hard gate for the tier frontier: any SHIPPED tier whose
     # golden-set mel_l2 exceeds its tolerance is a quality outage, not
     # a 10%-threshold matter — the canary gate exists to keep such a
@@ -4478,6 +4875,7 @@ if __name__ == "__main__":
         run_longform(duration=dur)
         run_tiers(duration=dur)
         run_trace(duration=dur)
+        run_quality(duration=dur)
     elif "--tiers" in sys.argv:
         dur = (float(sys.argv[sys.argv.index("--duration") + 1])
                if "--duration" in sys.argv else 3.0)
@@ -4513,6 +4911,10 @@ if __name__ == "__main__":
         dur = (float(sys.argv[sys.argv.index("--duration") + 1])
                if "--duration" in sys.argv else 3.0)
         run_trace(duration=dur)
+    elif "--quality" in sys.argv:
+        dur = (float(sys.argv[sys.argv.index("--duration") + 1])
+               if "--duration" in sys.argv else 3.0)
+        run_quality(duration=dur)
     elif "--fleet" in sys.argv:
         dur = (float(sys.argv[sys.argv.index("--duration") + 1])
                if "--duration" in sys.argv else 3.0)

@@ -151,6 +151,17 @@ JL026  label-cardinality bomb at a metric registration site:
        identity belongs on trace spans and JSONL events; metric labels
        stay bounded (class, replica, reason, bucket).
        Tree baseline: zero.
+JL027  audio bytes leaving serving code without the quality choke
+       point: an int16 PCM conversion (``.astype(np.int16)``), a RIFF
+       container build (``wav_bytes(...)``), or an audio buffer
+       serialization (``wav.tobytes()`` — terminal receiver named
+       wav/pcm/audio/chunk/piece) in a function with NO
+       ``QualityGate.check``/``check_result``/``validate_wav``/
+       injected ``quality_check`` call, under speakingstyle_tpu/
+       serving/. Every wav must cross obs/quality.py where it is
+       produced or served — an unvalidated emission path is invisible
+       to the validators, the quality SLO burn stream, and the
+       golden-probe degradation drill. Tree baseline: zero.
 """
 
 import ast
@@ -2745,6 +2756,136 @@ def rule_jl026(mod: ModuleInfo) -> Iterator[Finding]:
             )
 
 
+# ---------------------------------------------------------------------------
+# JL027 — audio bytes leaving serving code without the quality choke point
+# ---------------------------------------------------------------------------
+
+# terminal identifiers whose ``.tobytes()`` is audio leaving the process
+_JL027_AUDIO_TERMINALS = ("wav", "pcm", "audio", "chunk", "piece")
+
+# bare-call leaves that count as validator evidence
+_JL027_VALIDATORS = (
+    "validate_wav", "check_wav", "check_result", "quality_check",
+)
+
+
+def _jl027_is_emission(node: ast.Call) -> Optional[str]:
+    """What kind of audio-emission site a call is, or None.
+
+    Three shapes: ``wav_bytes(...)`` (the RIFF container),
+    ``<x>.astype(np.int16 | "int16")`` (the float->PCM conversion every
+    audio path performs exactly once), and ``<audio-ish>.tobytes()``
+    where the receiver's TERMINAL identifier names audio (``wav``,
+    ``chunk.tobytes()`` — terminal-only, so ``np.asarray(wav,
+    np.int16).tobytes()`` inside the sanctioned container helper and a
+    generic ``a.tobytes()`` stay clean)."""
+    func = node.func
+    leaf = (func.attr if isinstance(func, ast.Attribute)
+            else func.id if isinstance(func, ast.Name) else "")
+    if leaf == "wav_bytes":
+        return "wav_bytes(...)"
+    if leaf == "astype" and node.args:
+        a = node.args[0]
+        if ((isinstance(a, ast.Attribute) and a.attr == "int16")
+                or (isinstance(a, ast.Name) and a.id == "int16")
+                or (isinstance(a, ast.Constant) and a.value == "int16")):
+            return ".astype(int16)"
+    if leaf == "tobytes" and isinstance(func, ast.Attribute):
+        recv = func.value
+        name = (recv.id if isinstance(recv, ast.Name)
+                else recv.attr if isinstance(recv, ast.Attribute) else "")
+        low = name.lower()
+        for t in _JL027_AUDIO_TERMINALS:
+            if low == t or low.endswith("_" + t) or low.startswith(t):
+                return f"{name}.tobytes()"
+    return None
+
+
+def _jl027_is_evidence(node: ast.Call) -> bool:
+    """A call that passes audio through the quality choke point:
+    a dotted call through something named ``quality`` whose leaf
+    checks/validates (``self.quality.check``, ``outer.quality_gate
+    .check_result``, the Stitcher's ``self.quality_check(p)``), or a
+    bare validator call (``validate_wav(...)``)."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        dotted = _dotted(func).lower()
+        leaf = func.attr.lower()
+        return "quality" in dotted and (
+            "check" in leaf or "validate" in leaf
+        )
+    if isinstance(func, ast.Name):
+        return func.id in _JL027_VALIDATORS
+    return False
+
+
+def rule_jl027(mod: ModuleInfo) -> Iterator[Finding]:
+    """JL027: audio bytes leaving serving code without passing the
+    quality choke point (obs/quality.py).
+
+    The quality observability plane only works if EVERY wav crosses the
+    validator exactly where it is produced or served: the engine's batch
+    and streaming collect paths, the long-form stitcher, and the HTTP
+    boundary all call ``QualityGate.check``/``check_result`` (or the
+    stitcher's injected ``quality_check``) before bytes move on. A new
+    audio path that converts to int16 PCM, wraps a RIFF container
+    (``wav_bytes``), or serializes an audio buffer (``wav.tobytes()``)
+    WITHOUT validator evidence in the same function ships garbage the
+    whole plane — counters, quality SLO burn, pinned traces, paging —
+    is blind to. The rule is lexical per enclosing function: any
+    quality-check call in the function (or an enclosing one) sanctions
+    its emissions; genuinely non-audio int16 conversions get
+    ``# jaxlint: disable=JL027 reason=...``.
+    """
+    p = mod.path.replace("\\", "/")
+    if "speakingstyle_tpu/serving/" not in p:
+        return
+    evidence_fns = set()
+    for node in mod.walk():
+        if isinstance(node, ast.Call) and _jl027_is_evidence(node):
+            fn = mod.enclosing_function(node)
+            if fn is not None:
+                evidence_fns.add(fn)
+    for node in mod.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        what = _jl027_is_emission(node)
+        if what is None:
+            continue
+        # sanctioned if this function — or any function it is nested
+        # inside (a helper closure emits what the handler validated) —
+        # carries validator evidence
+        cur = mod.enclosing_function(node)
+        sanctioned = False
+        probe = cur
+        while probe is not None:
+            if probe in evidence_fns:
+                sanctioned = True
+                break
+            probe = mod.enclosing_function(probe)
+        if sanctioned:
+            continue
+        qual = mod.qualname(cur or mod.tree)
+        yield Finding(
+            rule="JL027",
+            path=mod.path,
+            line=node.lineno,
+            context=qual,
+            detail=f"unvalidated audio emission {what}",
+            message=(
+                f"`{what}` in {qual} emits audio bytes without passing "
+                "the quality choke point: no "
+                "`QualityGate.check/check_result`, `validate_wav`, or "
+                "injected `quality_check` call in this function. Every "
+                "wav must cross obs/quality.py where it is produced — "
+                "otherwise the validators, the quality SLO stream, and "
+                "the golden-probe drill are blind to this path. Route "
+                "the buffer through the engine/server gate (or call "
+                "validate_wav directly) before serializing."
+            ),
+        )
+
+
 RULES = {
     "JL001": rule_jl001,
     "JL002": rule_jl002,
@@ -2772,4 +2913,5 @@ RULES = {
     "JL024": rule_jl024,
     "JL025": rule_jl025,
     "JL026": rule_jl026,
+    "JL027": rule_jl027,
 }

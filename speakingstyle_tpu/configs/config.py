@@ -1238,6 +1238,15 @@ class SloConfig:
     slow_burn_threshold: float = 6.0
     # evaluation cadence of the stop-aware policy loop
     tick_s: float = 5.0
+    # traffic class -> audio-quality objective: the fraction of
+    # validated wavs (obs/quality.py choke point) that must pass.
+    # A separate stream from availability — the probe class exists
+    # ONLY here (probe traffic is excluded from the latency SLO)
+    quality_objectives: Dict[str, float] = field(
+        default_factory=lambda: {
+            "interactive": 0.99, "batch": 0.99, "probe": 0.99,
+        }
+    )
 
     def __post_init__(self):
         for klass, obj in self.objectives.items():
@@ -1245,6 +1254,12 @@ class SloConfig:
                 raise ValueError(
                     f"serve.slo.objectives[{klass!r}] must be in (0, 1), "
                     f"got {obj}"
+                )
+        for klass, obj in self.quality_objectives.items():
+            if not (0.0 < obj < 1.0):
+                raise ValueError(
+                    f"serve.slo.quality_objectives[{klass!r}] must be in "
+                    f"(0, 1), got {obj}"
                 )
         if self.fast_window_s <= 0:
             raise ValueError(
@@ -1265,6 +1280,72 @@ class SloConfig:
             raise ValueError(
                 f"serve.slo.tick_s must be > 0, got {self.tick_s}"
             )
+
+
+@dataclass(frozen=True)
+class QualityConfig:
+    """Audio-quality observability plane (obs/quality.py validators +
+    serving/probes.py golden prober).
+
+    Validator thresholds apply to every wav leaving the process
+    (engine batch path, streaming windows, longform stitcher); probe
+    knobs drive the background golden replays through the live fleet
+    on their own traffic class — excluded from autoscaler pressure
+    signals and the latency SLO, visible only to the quality SLO
+    stream (``serve.slo.quality_objectives``).
+    """
+
+    enabled: bool = True
+    # fraction of samples at >= 99.9% full scale before a wav fails
+    clip_fraction_max: float = 0.5
+    # longest exact-zero run (digital silence) a wav may carry
+    silence_run_ms_max: float = 500.0
+    # |mean| of the normalized wav (full scale = 1.0)
+    dc_offset_max: float = 0.5
+    # spectral flatness above this is a stuck/degenerate signal
+    # (constant -> ~1.0; white noise -> ~0.56; speech far below)
+    flatness_max: float = 0.9
+    # skip the flatness check below this many samples (no spectrum)
+    flatness_min_samples: int = 256
+    # traffic class golden probes ride on; must not collide with
+    # tenant classes — the fleet admits it with probe_deadline_ms and
+    # keeps it out of shed/pressure/latency-SLO accounting
+    probe_class: str = "probe"
+    probe_deadline_ms: float = 30_000.0
+    # cadence of the background prober's rounds
+    probe_interval_s: float = 30.0
+    # RMS mel-L2 drift vs the pinned anchor before the prober pages
+    # (healthy drift is ~0: same lattice, same seeds, same weights)
+    probe_mel_tolerance: float = 10.0
+    # RMS FiLM (gamma, beta) drift vs the pinned style baseline
+    probe_style_tolerance: float = 10.0
+    # where pinned anchors live ("" = alongside train.path.log_path)
+    anchor_dir: str = ""
+
+    def __post_init__(self):
+        for name in ("clip_fraction_max", "flatness_max"):
+            v = getattr(self, name)
+            if not (0.0 < v <= 1.0):
+                raise ValueError(
+                    f"serve.quality.{name} must be in (0, 1], got {v}"
+                )
+        for name in (
+            "silence_run_ms_max", "dc_offset_max", "probe_deadline_ms",
+            "probe_interval_s", "probe_mel_tolerance",
+            "probe_style_tolerance",
+        ):
+            v = getattr(self, name)
+            if v <= 0:
+                raise ValueError(
+                    f"serve.quality.{name} must be > 0, got {v}"
+                )
+        if self.flatness_min_samples < 2:
+            raise ValueError(
+                "serve.quality.flatness_min_samples must be >= 2, got "
+                f"{self.flatness_min_samples}"
+            )
+        if not self.probe_class:
+            raise ValueError("serve.quality.probe_class must be non-empty")
 
 
 @dataclass(frozen=True)
@@ -1351,6 +1432,8 @@ class ServeConfig:
     trace: TraceConfig = field(default_factory=TraceConfig)
     # multi-window SLO burn-rate accounting per traffic class
     slo: SloConfig = field(default_factory=SloConfig)
+    # audio-quality plane: output validators + live golden probes
+    quality: QualityConfig = field(default_factory=QualityConfig)
 
     def __post_init__(self):
         for name in ("batch_buckets", "src_buckets", "mel_buckets"):

@@ -53,6 +53,14 @@ Counter semantics per kind:
                             here on (dispatches fail fast, heartbeats
                             stop renewing the lease) until the drill
                             heals it — the partition-grade chaos drill
+  ``tier_poison@N``         same counter; the Nth coalesced dispatch
+                            poisons the target replica engine's param
+                            tree host-side (same shapes/dtypes — zero
+                            compiles, no errors) so it keeps serving
+                            GARBAGE audio — the quality-plane
+                            degradation drill: only the validators
+                            (obs/quality.py) and the golden probes
+                            (serving/probes.py) can see it
 
   checkpoint (training/checkpoint.py; the lifecycle drills):
 
@@ -84,6 +92,7 @@ TRAINING_KINDS = ("loader_ioerror", "nan_grads", "sigterm")
 SERVING_KINDS = (
     "replica_raise", "replica_hang", "style_encode_error", "vocoder_raise",
     "longform_ring_error", "replica_proc_kill", "net_partition",
+    "tier_poison",
 )
 CHECKPOINT_KINDS = ("checkpoint_corrupt", "manifest_missing")
 KINDS = TRAINING_KINDS + SERVING_KINDS + CHECKPOINT_KINDS

@@ -16,7 +16,11 @@ The single instrumented spine shared by training, data, and serving
     compiled XLA executables (per-program FLOPs/bytes/peak memory,
     achieved-FLOP/s export);
   * ``buildinfo`` — build/runtime identity (git SHA, jax versions,
-    backend) + process RSS for /healthz and /metrics.
+    backend) + process RSS for /healthz and /metrics;
+  * ``quality`` — the audio-output validator choke point (cheap
+    host-side wav checks feeding the quality SLO stream);
+  * ``slo`` — multi-window burn-rate accounting over the latency AND
+    quality counter streams.
 
 Zero dependencies, no jax import at module scope.
 """
@@ -40,6 +44,11 @@ from speakingstyle_tpu.obs.jaxmon import (
     enable_compilation_cache,
     watch_compiles,
 )
+from speakingstyle_tpu.obs.quality import (
+    QualityGate,
+    WavVerdict,
+    validate_wav,
+)
 from speakingstyle_tpu.obs.registry import (
     DEFAULT_TIME_BUCKETS,
     Counter,
@@ -60,7 +69,9 @@ __all__ = [
     "JsonlEventLog",
     "MetricsRegistry",
     "ProgramCard",
+    "QualityGate",
     "Span",
+    "WavVerdict",
     "array_sha256",
     "build_info",
     "device_memory_watermark",
@@ -71,6 +82,7 @@ __all__ = [
     "publish_program_gauges",
     "read_events",
     "span",
+    "validate_wav",
     "watch_compiles",
     "weights_digest",
 ]

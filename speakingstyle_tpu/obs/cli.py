@@ -31,6 +31,19 @@ spans (obs/trace.py records, the fleet observability plane):
   events — with the critical path (the last-exit chain that gated
   end-to-end latency) marked ``*`` and summarized at the bottom.
 
+``quality`` subcommand — summarize the audio-quality plane's JSONL
+events (validator failures, golden-probe rounds, drift + quality-SLO
+pages; obs/quality.py, serving/probes.py, obs/slo.py):
+
+  python -m speakingstyle_tpu.obs.cli quality LOG_DIR
+
+  prints the validator failure tally by (tier, reason) with the worst
+  offenders first and the most recent failure's identity, each tier's
+  probe drift trajectory (rounds, first/last/worst mel drift, style
+  drift), and the chronological page timeline — probe_drift_alert /
+  slo_quality_alert transitions with their resolutions and exemplar
+  trace ids.
+
 No jax import — safe to run on a login node against a live run's logs.
 """
 
@@ -297,11 +310,140 @@ def trace(path, trace_id=None, out=None):
     return 0
 
 
+def build_quality_parser(parser=None):
+    parser = parser or argparse.ArgumentParser(
+        prog="python -m speakingstyle_tpu.obs.cli quality",
+        description="summarize audio-quality validator/probe/SLO events",
+    )
+    parser.add_argument(
+        "path", help="train.path.log_path directory or an events.jsonl file"
+    )
+    return parser
+
+
+_QUALITY_EVENTS = (
+    "quality_fail",
+    "probe_round",
+    "probe_drift_alert", "probe_drift_resolved",
+    "slo_quality_alert", "slo_quality_resolved",
+    "probe_error",
+)
+
+
+def quality(path, out=None):
+    """Summarize the quality plane's event stream: validator failures
+    by (tier, reason), per-tier probe drift trajectory, and the page
+    timeline (drift + quality-SLO alert transitions)."""
+    out = out if out is not None else sys.stdout  # late-bound: capturable
+    fails = []
+    rounds = []
+    timeline = []
+    errors = collections.Counter()
+    for rec in read_events(path):
+        event = rec.get("event")
+        if event not in _QUALITY_EVENTS:
+            continue
+        if event == "quality_fail":
+            fails.append(rec)
+        elif event == "probe_round":
+            rounds.append(rec)
+        elif event == "probe_error":
+            errors[
+                f"{rec.get('tier', '?')}/{rec.get('stage', '?')}"
+            ] += 1
+        else:
+            timeline.append(rec)
+    if not (fails or rounds or timeline or errors):
+        print(f"no quality-plane events under {path}", file=out)
+        return 1
+
+    t0 = min(
+        (rec.get("ts") for rec in fails + rounds + timeline
+         if isinstance(rec.get("ts"), (int, float))),
+        default=None,
+    )
+
+    def rel(ts):
+        if t0 is None or not isinstance(ts, (int, float)):
+            return "      ?"
+        return f"{ts - t0:+8.1f}s"
+
+    # -- validator failures: worst offenders first ---------------------------
+    by_offender = collections.Counter()
+    for rec in fails:
+        tier = rec.get("tier", "?")
+        for reason in rec.get("reasons") or ("?",):
+            by_offender[(tier, reason)] += 1
+    print(f"validator failures: {len(fails)}", file=out)
+    for (tier, reason), n in by_offender.most_common():
+        print(f"  {tier:16s} {reason:12s} {n}", file=out)
+    if fails:
+        last = fails[-1]
+        print(
+            f"  last: {rel(last.get('ts'))}  tier={last.get('tier')} "
+            f"class={last.get('class')} source={last.get('source')} "
+            f"reasons={','.join(last.get('reasons') or ())} "
+            f"req_id={last.get('req_id')} trace_id={last.get('trace_id')}",
+            file=out,
+        )
+
+    # -- probe drift trajectory per tier -------------------------------------
+    print(f"probe rounds: {len(rounds)}", file=out)
+    trajectory = collections.defaultdict(list)
+    style_drifts = []
+    for rec in rounds:
+        for tier, drift in (rec.get("tiers") or {}).items():
+            if isinstance(drift, (int, float)):
+                trajectory[tier].append(drift)
+        sd = rec.get("style_drift")
+        if isinstance(sd, (int, float)):
+            style_drifts.append(sd)
+    for tier, drifts in sorted(trajectory.items()):
+        print(
+            f"  {tier:16s} rounds={len(drifts)} "
+            f"first={drifts[0]:.4g} last={drifts[-1]:.4g} "
+            f"worst={max(drifts):.4g}",
+            file=out,
+        )
+    if style_drifts:
+        print(
+            f"  {'(style)':16s} rounds={len(style_drifts)} "
+            f"first={style_drifts[0]:.4g} last={style_drifts[-1]:.4g} "
+            f"worst={max(style_drifts):.4g}",
+            file=out,
+        )
+    for key, n in errors.most_common():
+        print(f"  probe errors {key}: {n}", file=out)
+
+    # -- page timeline --------------------------------------------------------
+    print(f"page timeline: {len(timeline)} transition(s)", file=out)
+    for rec in timeline:
+        event = rec.get("event")
+        if event.startswith("probe_"):
+            drift = rec.get("mel_drift", rec.get("style_drift"))
+            detail = (
+                f"tier={rec.get('tier')} drift={drift} "
+                f"tolerance={rec.get('tolerance')}"
+            )
+        else:
+            detail = (
+                f"class={rec.get('klass')} "
+                f"fast_burn={rec.get('fast_burn')} "
+                f"slow_burn={rec.get('slow_burn')} "
+                f"trace_id={rec.get('trace_id')}"
+            )
+        print(f"  {rel(rec.get('ts'))}  {event:22s} {detail}", file=out)
+    return 0
+
+
 def main(argv=None):
     argv = sys.argv[1:] if argv is None else list(argv)
     if argv and argv[0] == "trace":
         args = build_trace_parser().parse_args(argv[1:])
         return trace(args.path, trace_id=args.trace_id)
+    if argv and argv[0] == "quality":
+        args = build_quality_parser().parse_args(argv[1:])
+        return quality(args.path)
     if argv and argv[0] == "programs":
         args = build_programs_parser().parse_args(argv[1:])
         return programs(args.path, peak_flops=args.peak_flops)

@@ -30,6 +30,18 @@ and future multi-tenant quotas read the same gauges.  Construct with
 ``start=False`` and drive ``step(now=...)`` with an explicit clock for
 tests (the same idiom as ``serving/autoscale.py``).
 
+**The quality stream.** The same construction runs a second time over
+the audio-quality good/bad counters the validator choke point
+maintains (obs/quality.py: ``serve_quality_class_total`` /
+``serve_quality_class_fail_total``), against
+``serve.slo.quality_objectives`` — so a tier shipping garbage audio
+pages exactly like a tier missing deadlines: two windows, burn-rate
+gauges (``serve_slo_quality_burn_rate``), and edge-triggered
+``slo_quality_alert`` / ``slo_quality_resolved`` events carrying the
+exemplar trace id the ``quality_fail`` KEEP_REASON pinned.  The probe
+class (live golden probes, serving/probes.py) exists ONLY in this
+stream — probe traffic never appears in the latency objectives.
+
 Zero dependencies, no jax import — obs-layer rules apply.
 """
 
@@ -60,13 +72,24 @@ class SloEngine:
         self.trace_ring = trace_ring
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # (t, {class: (total, bad)}) cumulative samples, oldest first;
-        # trimmed to the slow window + one tick each step
+        # (t, {key: (total, bad)}) cumulative samples, oldest first;
+        # trimmed to the slow window + one tick each step. Keys are the
+        # class name for the latency stream and "q:<class>" for the
+        # quality stream — both streams share one sample history
         self._samples: List[Tuple[float, Dict[str, Tuple[float, float]]]] = []
         self._alerting: Dict[str, bool] = {
             k: False for k in scfg.objectives
         }
         self._burn: Dict[Tuple[str, str], float] = {}
+        # the audio-quality stream (obs/quality.py counters); absent
+        # quality_objectives (a pared-down test config) disables it
+        self.quality_objectives: Dict[str, float] = dict(
+            getattr(scfg, "quality_objectives", None) or {}
+        )
+        self._q_alerting: Dict[str, bool] = {
+            k: False for k in self.quality_objectives
+        }
+        self._q_burn: Dict[Tuple[str, str], float] = {}
         if start:
             self._thread = threading.Thread(
                 target=self._loop, name="slo-engine", daemon=True
@@ -91,6 +114,12 @@ class SloEngine:
             # denominator
             total += self.registry.value("serve_class_shed_total", labels)
             out[klass] = (total, bad)
+        for klass in self.quality_objectives:
+            labels = {"class": klass}
+            out[f"q:{klass}"] = (
+                self.registry.value("serve_quality_class_total", labels),
+                self.registry.value("serve_quality_class_fail_total", labels),
+            )
         return out
 
     def _window_delta(self, now: float, window_s: float,
@@ -169,10 +198,78 @@ class SloEngine:
                         slow_window_s=self.scfg.slow_window_s,
                         trace_id=trace_id,
                     )
+        for klass, objective in self.quality_objectives.items():
+            budget = 1.0 - objective
+            burns = {}
+            for window, window_s in (
+                ("fast", self.scfg.fast_window_s),
+                ("slow", self.scfg.slow_window_s),
+            ):
+                total, bad = self._window_delta(now, window_s, f"q:{klass}")
+                ratio = (bad / total) if total > 0 else 0.0
+                burn = ratio / budget
+                burns[window] = burn
+                self._q_burn[(klass, window)] = burn
+                self.registry.gauge(
+                    "serve_slo_quality_burn_rate",
+                    labels={"class": klass, "window": window},
+                    help="audio-quality error-budget burn rate per class "
+                         "and window (validator fail fraction over the "
+                         "quality objective's budget)",
+                ).set(burn)
+            firing = (burns["fast"] >= self.scfg.fast_burn_threshold
+                      and burns["slow"] >= self.scfg.slow_burn_threshold)
+            was = self._q_alerting[klass]
+            if firing != was:
+                self._q_alerting[klass] = firing
+                if firing:
+                    self.registry.counter(
+                        "serve_slo_quality_alerts_total",
+                        labels={"class": klass},
+                        help="slo_quality_alert transitions fired per class",
+                    ).inc()
+                if self.events is not None:
+                    trace_id = None
+                    if self.trace_ring is not None:
+                        trace_id = self.trace_ring.last_pinned_trace_id
+                    self.events.emit(
+                        "slo_quality_alert" if firing
+                        else "slo_quality_resolved",
+                        klass=klass,
+                        objective=objective,
+                        fast_burn=round(burns["fast"], 3),
+                        slow_burn=round(burns["slow"], 3),
+                        fast_window_s=self.scfg.fast_window_s,
+                        slow_window_s=self.scfg.slow_window_s,
+                        trace_id=trace_id,
+                    )
         return dict(self._alerting)
 
     def burn_rate(self, klass: str, window: str) -> float:
         return self._burn.get((klass, window), 0.0)
+
+    def quality_burn_rate(self, klass: str, window: str) -> float:
+        return self._q_burn.get((klass, window), 0.0)
+
+    def quality_alerting(self) -> Dict[str, bool]:
+        """Per-class alerting state of the quality stream (the tests'
+        and bench drill's direct read)."""
+        return dict(self._q_alerting)
+
+    def quality_status(self) -> Dict:
+        """The /healthz quality block's SLO view: per-class quality
+        objective, both windows' burn, and the alerting flag."""
+        return {
+            klass: {
+                "objective": objective,
+                "fast_burn": round(
+                    self._q_burn.get((klass, "fast"), 0.0), 4),
+                "slow_burn": round(
+                    self._q_burn.get((klass, "slow"), 0.0), 4),
+                "alerting": self._q_alerting.get(klass, False),
+            }
+            for klass, objective in self.quality_objectives.items()
+        }
 
     def status(self) -> Dict:
         """The /healthz ``slo`` block: per-class objective, both

@@ -311,7 +311,7 @@ class TailSampler:
 
     KEEP_REASONS = (
         "shed", "deadline_exceeded", "hedge_won", "deadline_miss",
-        "error",
+        "error", "quality_fail",
     )
 
     def __init__(self, sample_rate: float = 0.1):

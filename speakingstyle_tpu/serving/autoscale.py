@@ -39,6 +39,15 @@ thread exits within one tick, so drain/shutdown is never blocked by a
 sleeping policy thread. Armed via the ``serve.autoscale.*`` config block
 and OFF by default: with ``enabled: false`` nothing constructs one and
 the replica count stays wherever ``scale_to()`` last put it.
+
+**Probe traffic is invisible here.** The golden prober
+(serving/probes.py) replays its corpus on ``serve.quality.probe_class``,
+and the router excludes that class from every signal this policy reads:
+``pending_depth()`` and ``occupancy()`` skip probe entries, and probe
+sheds/misses land on the ``serve_probe_*`` counter family instead of the
+shed/deadline counters differentiated into the pressure rate. A probe
+round can therefore never buy a replica (or hold one against a
+drain) — synthetic quality traffic must not masquerade as demand.
 """
 
 import threading

@@ -154,6 +154,9 @@ class SynthesisRequest:
     # propagated trace context (obs/trace.TraceContext): this request's
     # node in the distributed trace — None for untraced callers
     trace: Optional[TraceContext] = None
+    # run this request's wav through the quality choke point
+    # (obs/quality.py); benches toggle it to measure the paired cost
+    quality_check: bool = True
 
 
 @dataclass
@@ -186,6 +189,12 @@ class SynthesisResult:
     # stages (streaming vocode windows, response tagging) can parent
     # their spans without a side lookup
     trace: Optional[TraceContext] = None
+    # the request's traffic class, carried through so post-dispatch
+    # stages (streaming vocode windows) account quality per class
+    priority: Optional[str] = None
+    # the quality choke point's verdict on this result's wav
+    # (obs/quality.WavVerdict) — None for mel-only or unchecked results
+    quality: Optional[object] = None
 
 
 def _fill_control(rows: List[Control], out: np.ndarray) -> np.ndarray:
@@ -217,6 +226,11 @@ class VocodeHandle:
     t_w: int                       # real frames in the window
     hop: int                       # generator hop factor (trim unit)
     buf: Optional[np.ndarray]      # pooled input buffer; None once released
+    # quality-plane context the window's collect accounts under: the
+    # owning request's traffic class and trace (serving/streaming.py
+    # passes them through from the SynthesisResult)
+    klass: Optional[str] = None
+    trace: Optional[TraceContext] = None
 
 
 class SynthesisEngine:
@@ -405,6 +419,17 @@ class SynthesisEngine:
             "serve_emit_seconds",
             help="stage: host wav conversion + overlap trim per window",
         )
+        # the audio-quality choke point (obs/quality.py): every wav this
+        # engine emits — batch rows, streaming windows — passes through
+        # it before leaving the process. The fleet late-binds tier name
+        # and trace plumbing after warm-up (QualityGate.bind).
+        from speakingstyle_tpu.obs.quality import QualityGate
+
+        self.quality = QualityGate(
+            getattr(cfg.serve, "quality", None),
+            pp.audio.sampling_rate,
+            registry=self.registry,
+        )
 
     @property
     def compile_count(self) -> int:
@@ -437,6 +462,36 @@ class SynthesisEngine:
         sharding specs it was built against (the ``GET /debug/programs``
         payload — a mesh replica's programs show their partitioning)."""
         return self.program_registry.programs()
+
+    def poison_params(self, precision: Optional[str] = None,
+                      scale: float = 1e3) -> str:
+        """Degrade one precision tier's acoustic param tree in place —
+        the ``tier_poison`` fault (faults.py): the corrupt-reload /
+        misrouted-precision failure mode the quality plane exists to
+        catch. Every leaf is scaled HOST-side (numpy, no traced math —
+        zero compiles) and put back with its original sharding: same
+        shapes, same dtypes, so no program recompiles and nothing
+        errors — the next dispatch simply produces garbage audio that
+        only the validators and golden probes can see."""
+        import jax
+
+        prec = precision or self.default_precision
+
+        def _poison(x):
+            host = np.asarray(jax.device_get(x))
+            bad = (host.astype(np.float32) * scale).astype(host.dtype)
+            sharding = getattr(x, "sharding", None)
+            if sharding is not None:
+                return jax.device_put(bad, sharding)
+            return jax.device_put(bad)
+
+        tree = jax.tree_util.tree_map(
+            _poison, self._params_by_precision[prec]
+        )
+        self._params_by_precision[prec] = tree
+        if prec == "f32":
+            self.variables = tree
+        return prec
 
     def _dispatch_flops(self, bucket: Bucket, precision: str) -> Optional[float]:
         """Total card FLOPs one dispatch at ``bucket`` executes (acoustic
@@ -663,7 +718,10 @@ class SynthesisEngine:
 
     # -- streaming window vocode --------------------------------------------
 
-    def vocode_dispatch(self, mel: np.ndarray) -> VocodeHandle:
+    def vocode_dispatch(
+        self, mel: np.ndarray, klass: Optional[str] = None,
+        trace: Optional[TraceContext] = None,
+    ) -> VocodeHandle:
         """Enqueue one mel window ``[T_w, n_mels]`` on the precompiled
         vocoder lattice and return without blocking.
 
@@ -715,7 +773,8 @@ class SynthesisEngine:
             self.pool.release(padded)
             raise
         return VocodeHandle(
-            wav_dev=wav_dev, t_w=t_w, hop=gen.hop_factor, buf=padded
+            wav_dev=wav_dev, t_w=t_w, hop=gen.hop_factor, buf=padded,
+            klass=klass, trace=trace,
         )
 
     def _release_handle(self, handle: VocodeHandle) -> None:
@@ -735,10 +794,20 @@ class SynthesisEngine:
             # the zero-steady-state-compiles monitor rightly flags
             wav_host = np.asarray(handle.wav_dev)  # <- the sync point
             t1 = time.monotonic()
+            # slice the float row BEFORE converting: the finite check
+            # must see NaN/Inf that np.clip would otherwise erase
+            wav_f = wav_host[0, : handle.t_w * handle.hop]
+            finite = bool(np.isfinite(wav_f).all())
+            if not finite:
+                wav_f = np.nan_to_num(wav_f, posinf=1.0, neginf=-1.0)
             wav = np.clip(
-                wav_host[0] * self.max_wav_value,
+                wav_f * self.max_wav_value,
                 -self.max_wav_value, self.max_wav_value - 1,
-            ).astype(np.int16)[: handle.t_w * handle.hop]
+            ).astype(np.int16)
+            self.quality.check(
+                wav, klass=handle.klass, source="stream", finite=finite,
+                trace=handle.trace,
+            )
             self._vocoder_hist.observe(t1 - t0)
             self._emit_hist.observe(time.monotonic() - t1)
             return wav
@@ -975,6 +1044,7 @@ class SynthesisEngine:
             mel_out = out["mel_postnet"]  # [b, t, n_mels] device array
 
             wavs = None
+            wavs_finite = True
             hop = 1
             # streaming rows are vocoded window-by-window later
             # (serving/streaming.py); a batch of only-stream requests
@@ -994,9 +1064,15 @@ class SynthesisEngine:
                 wav_dev = self._vocoder_exe[(bucket.b, t)](params, mel_out)
                 # one vectorized int16 conversion for the whole batch
                 # (the per-item numpy work is what bounds coalesced
-                # throughput on the CPU bench)
+                # throughput on the CPU bench); the finite verdict is
+                # taken on the float batch first — np.clip erases the
+                # NaN/Inf evidence the quality gate needs
+                wav_f = np.asarray(wav_dev)
+                wavs_finite = bool(np.isfinite(wav_f).all())
+                if not wavs_finite:
+                    wav_f = np.nan_to_num(wav_f, posinf=1.0, neginf=-1.0)
                 wavs = np.clip(
-                    np.asarray(wav_dev) * self.max_wav_value,
+                    wav_f * self.max_wav_value,
                     -self.max_wav_value, self.max_wav_value - 1,
                 ).astype(np.int16)
             else:
@@ -1056,8 +1132,17 @@ class SynthesisEngine:
             mel_len = int(out_mel_lens[i])
             src_len = int(src_lens[i])
             wav = None
+            verdict = None
             if wavs is not None and not r.stream:
                 wav = wavs[i, : mel_len * hop]
+                # the full-utterance choke point (obs/quality.py): the
+                # batch finite verdict is a safe over-approximation per
+                # row (a non-finite batch marks every row suspect)
+                if r.quality_check:
+                    verdict = self.quality.check(
+                        wav, klass=r.priority, source="engine",
+                        finite=wavs_finite, trace=r.trace, req_id=r.id,
+                    )
             p_len = src_len if self._pitch_axis == "src" else mel_len
             e_len = src_len if self._energy_axis == "src" else mel_len
             results.append(SynthesisResult(
@@ -1074,6 +1159,8 @@ class SynthesisEngine:
                 batch_rows=n,
                 style_degraded=r.style_degraded,
                 trace=r.trace,
+                priority=r.priority,
+                quality=verdict,
             ))
         # one engine_run span per trace present in the coalesced batch
         # (requests from different traces share the dispatch — each

@@ -219,6 +219,18 @@ class FleetRouter:
             trace_cfg.sample_rate if trace_cfg is not None else 0.1
         )
         self.last_pressure_trace_id: Optional[str] = None
+        # golden-probe traffic class (obs/quality.py plane): admitted
+        # with its own deadline budget but EXCLUDED from shed/pressure
+        # accounting, the latency SLO stream, and the autoscaler's
+        # queue/occupancy signals — synthetic replays must never page
+        # latency or distort scaling (serving/probes.py)
+        qcfg = getattr(serve, "quality", None)
+        self._probe_class = (
+            qcfg.probe_class if qcfg is not None else "probe"
+        )
+        self._probe_deadline_ms = (
+            qcfg.probe_deadline_ms if qcfg is not None else 30_000.0
+        )
 
         self._shed_ctr = self.registry.counter(
             "serve_shed_total",
@@ -351,6 +363,15 @@ class FleetRouter:
         t0 = time.monotonic()
         try:
             engine = factory(self.registry)
+            # bind the engine's quality choke point (obs/quality.py) to
+            # this fleet's tier name and trace plumbing so a failing wav
+            # pins its trace exactly like a latency incident does
+            gate = getattr(engine, "quality", None)
+            if gate is not None:
+                gate.bind(
+                    tier=self.tier, trace_ring=self._trace_ring,
+                    tail_sampler=self._tail_sampler, events=self.events,
+                )
             secs = engine.precompile()
             self.registry.gauge(
                 "serve_replica_precompile_seconds",
@@ -501,9 +522,13 @@ class FleetRouter:
     # -- autoscaler signal surface (serving/autoscale.py reads these) -------
 
     def pending_depth(self) -> int:
-        """Current EDF pending-heap occupancy."""
+        """Current EDF pending-heap occupancy, EXCLUDING probe-class
+        entries: golden probes must not feed the autoscaler's queue
+        signal (a probe burst is not tenant demand)."""
         with self._cond:
-            return len(self._heap)
+            return sum(
+                p.klass != self._probe_class for p in self._heap
+            )
 
     def live_replica_count(self) -> int:
         """Replicas counted by ``scale_to`` (cold/warming/ready/failed)
@@ -517,12 +542,20 @@ class FleetRouter:
     def occupancy(self) -> float:
         """Instantaneous busy fraction of READY replicas (a replica is
         busy while it holds an in-flight dispatch claim); 0.0 when none
-        are READY."""
+        are READY. A claim holding ONLY probe-class requests does not
+        count as busy — golden probes must not feed the autoscaler's
+        occupancy signal."""
         with self._cond:
             ready = [r for r in self._replicas if r.state == READY]
             if not ready:
                 return 0.0
-            return sum(r.inflight is not None for r in ready) / len(ready)
+            busy = sum(
+                r.inflight is not None and any(
+                    p.klass != self._probe_class for p in r.inflight
+                )
+                for r in ready
+            )
+            return busy / len(ready)
 
     def warmup_cost_s(self) -> Optional[float]:
         """Measured warm-up cost (p50 of serve_replica_warmup_seconds);
@@ -551,7 +584,8 @@ class FleetRouter:
         the lattice is consulted, so admission works while every replica
         is still warming). Returns the resolved priority class."""
         klass = req.priority or self.fleet.default_class
-        if klass not in self.fleet.class_deadline_ms:
+        if (klass not in self.fleet.class_deadline_ms
+                and klass != self._probe_class):
             raise ValueError(
                 f"unknown priority class {klass!r}; configured classes: "
                 f"{sorted(self.fleet.class_deadline_ms)}"
@@ -588,6 +622,10 @@ class FleetRouter:
         the EDF heap forever."""
         override = getattr(req, "deadline_ms", None)
         if override is None:
+            if klass == self._probe_class:
+                # probes carry their own budget (serve.quality), never
+                # a tenant class's deadline
+                return self._probe_deadline_ms / 1e3
             return self.fleet.class_deadline_ms[klass] / 1e3
         if override <= 0:
             raise ValueError(
@@ -596,8 +634,11 @@ class FleetRouter:
             )
         return min(float(override), self.fleet.max_deadline_ms) / 1e3
 
-    def _check_shed(self) -> None:
-        """Watermark hysteresis; caller holds ``self._cond``."""
+    def _check_shed(self, count: bool = True) -> None:
+        """Watermark hysteresis; caller holds ``self._cond``.
+        ``count=False`` (probe-class submits) sheds without bumping
+        ``serve_shed_total`` — the autoscaler's pressure signal must
+        not see synthetic probe traffic."""
         depth = len(self._heap)
         cap = self.fleet.queue_depth
         if self._shedding:
@@ -606,7 +647,8 @@ class FleetRouter:
         elif depth >= self.fleet.shed_high_watermark * cap:
             self._shedding = True
         if self._shedding:
-            self._shed_ctr.inc()
+            if count:
+                self._shed_ctr.inc()
             # Retry-After = hysteresis gap / measured drain rate: the
             # seconds until the heap is back under the low watermark
             # (where admission resumes) at the current service rate;
@@ -626,14 +668,26 @@ class FleetRouter:
         SynthesisResult. Raises RequestTooLarge/ValueError on geometry,
         Overloaded past the shed watermark, ShutdownError after close."""
         klass = self._admit(request)
+        is_probe = klass == self._probe_class
         fut: Future = Future()
         with self._cond:
             if self._closing:
                 self._rejected_ctr.inc()
                 raise ShutdownError("router is closed")
             try:
-                self._check_shed()
+                self._check_shed(count=not is_probe)
             except Overloaded:
+                if is_probe:
+                    # probe sheds are accounted on their own family:
+                    # neither serve_shed_total (autoscaler pressure)
+                    # nor serve_class_shed_total (latency SLO bad
+                    # stream) may see synthetic traffic
+                    self.registry.counter(
+                        "serve_probe_shed_total",
+                        help="probe-class submits shed by backpressure "
+                             "(excluded from pressure + latency SLO)",
+                    ).inc()
+                    raise
                 # the classless serve_shed_total already counted inside
                 # _check_shed; this per-class family is what the SLO
                 # burn-rate engine differentiates (obs/slo.py)
@@ -659,10 +713,17 @@ class FleetRouter:
                 submit_mono=time.monotonic(),
             ))
             self._pending_gauge.set(len(self._heap))
-            self.registry.counter(
-                "serve_class_requests_total", labels={"class": klass},
-                help="requests admitted per priority class",
-            ).inc()
+            if is_probe:
+                self.registry.counter(
+                    "serve_probe_requests_total",
+                    help="probe-class requests admitted (the quality "
+                         "plane's golden replays — not tenant traffic)",
+                ).inc()
+            else:
+                self.registry.counter(
+                    "serve_class_requests_total", labels={"class": klass},
+                    help="requests admitted per priority class",
+                ).inc()
             self._cond.notify_all()
         return fut
 
@@ -735,13 +796,22 @@ class FleetRouter:
             # already resolved (a failed frontend resolution that was
             # then stolen/requeued): the verdict is out, nothing to add
             return
-        self.registry.counter(
-            "serve_deadline_exceeded_total", labels={"class": p.klass},
-            help="requests resolved 504 instead of dispatched past their "
-                 "class deadline budget",
-        ).inc()
         ctx = getattr(p.request, "trace", None)
-        self._note_pressure(ctx, "deadline_exceeded")
+        if p.klass == self._probe_class:
+            # probe expiry: own counter, no class label, no pressure
+            # pin — the latency SLO and autoscaler never see probes
+            self.registry.counter(
+                "serve_probe_deadline_exceeded_total",
+                help="probe-class requests resolved 504 before dispatch "
+                     "(excluded from the latency SLO bad stream)",
+            ).inc()
+        else:
+            self.registry.counter(
+                "serve_deadline_exceeded_total", labels={"class": p.klass},
+                help="requests resolved 504 instead of dispatched past "
+                     "their class deadline budget",
+            ).inc()
+            self._note_pressure(ctx, "deadline_exceeded")
         if self.events is not None:
             self.events.emit(
                 "deadline_exceeded", req_id=p.request.id, klass=p.klass,
@@ -848,6 +918,16 @@ class FleetRouter:
                         raise InjectedFault(
                             f"injected net_partition at dispatch {n}"
                         )
+                if self.fault_plan.fire("tier_poison", n):
+                    # the quality-plane degradation drill: corrupt this
+                    # replica's param tree in place (same shapes, zero
+                    # compiles) and CONTINUE — the dispatch succeeds,
+                    # the audio is garbage, and only the validators +
+                    # golden probes can page it
+                    # jaxlint: disable=JL020 reason=engine set under _cond before this generation's worker starts and never reassigned within a generation
+                    poison = getattr(rep.engine, "poison_params", None)
+                    if poison is not None:
+                        poison()
             # jaxlint: disable=JL020 reason=engine set under _cond before this generation's worker starts and never reassigned within a generation
             results = rep.engine.run([p.request for p in batch])
         except BaseException as e:
@@ -912,12 +992,23 @@ class FleetRouter:
                 self._latency_hist.observe(now - p.request.arrival)
                 ctx = getattr(p.request, "trace", None)
                 if now > p.slo_deadline:
-                    self.registry.counter(
-                        "serve_deadline_miss_total",
-                        labels={"class": p.klass},
-                        help="requests completed past their SLO deadline",
-                    ).inc()
-                    self._note_pressure(ctx, "deadline_miss")
+                    if p.klass == self._probe_class:
+                        # probe misses stay off the latency SLO bad
+                        # stream and off the pressure/pin path
+                        self.registry.counter(
+                            "serve_probe_deadline_miss_total",
+                            help="probe-class requests completed past "
+                                 "their probe deadline (excluded from "
+                                 "the latency SLO bad stream)",
+                        ).inc()
+                    else:
+                        self.registry.counter(
+                            "serve_deadline_miss_total",
+                            labels={"class": p.klass},
+                            help="requests completed past their SLO "
+                                 "deadline",
+                        ).inc()
+                        self._note_pressure(ctx, "deadline_miss")
                 elif ctx is not None and \
                         self._tail_sampler.keep(ctx.trace_id):
                     # healthy traffic: deterministic sample-rate dice

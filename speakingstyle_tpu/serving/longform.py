@@ -199,13 +199,17 @@ class Stitcher:
     gates as ``longform_seam_rms_max``.
     """
 
-    def __init__(self, fade_samples: int):
+    def __init__(self, fade_samples: int, quality_check=None):
         if fade_samples < 0:
             raise ValueError(f"fade_samples must be >= 0, got {fade_samples}")
         self.fade = int(fade_samples)
         self._tail: Optional[np.ndarray] = None
         self._last_emitted: float = 0.0  # last sample before the seam
         self.seam_rms: List[float] = []
+        # the longform choke point (obs/quality.py QualityGate.check
+        # bound by LongformService): every emitted piece — crossfade
+        # mixes included — is validated before it leaves the stitcher
+        self.quality_check = quality_check
 
     def _note_seam(self, prev: float, mixed: np.ndarray, nxt: float) -> None:
         window = np.empty(mixed.size + 2, np.float32)
@@ -263,11 +267,19 @@ class Stitcher:
             if piece.size:
                 self._last_emitted = float(piece[-1])
                 break
-        return [p for p in out if p.size]
+        pieces = [p for p in out if p.size]
+        if self.quality_check is not None:
+            for p in pieces:
+                self.quality_check(p)
+        return pieces
 
     def finish(self) -> List[np.ndarray]:
         tail, self._tail = self._tail, None
-        return [tail] if tail is not None and tail.size else []
+        pieces = [tail] if tail is not None and tail.size else []
+        if self.quality_check is not None:
+            for p in pieces:
+                self.quality_check(p)
+        return pieces
 
 
 # ---------------------------------------------------------------------------
@@ -596,6 +608,7 @@ class LongformService:
         fault_plan: Optional[FaultPlan] = None,
         registry: Optional[MetricsRegistry] = None,
         events=None,
+        quality=None,           # obs/quality.QualityGate (None = unchecked)
     ):
         self.cfg = cfg
         self.frontend = frontend
@@ -603,6 +616,7 @@ class LongformService:
         self.engine = engine
         self.ring = ring
         self.fault_plan = fault_plan
+        self.quality = quality
         if registry is not None:
             self.registry = registry
         elif engine is not None:
@@ -854,10 +868,28 @@ class LongformService:
             style_degraded=plan.style_degraded,
         )
 
+    def _quality_check_for(self, plan: LongformPlan):
+        """The stitcher's choke-point binding: every emitted piece is
+        validated under the chapter's traffic class (obs/quality.py).
+        None when the service has no gate — stitching is unchecked."""
+        if self.quality is None:
+            return None
+
+        def check(wav):
+            return self.quality.check(
+                wav, klass=self.klass, source="longform",
+                req_id=plan.req_id,
+            )
+
+        return check
+
     def _chunked(self, plan: LongformPlan) -> Iterator[np.ndarray]:
         lf = self.cfg.serve.longform
         hop = self.cfg.preprocess.preprocessing.stft.hop_length
-        stitcher = Stitcher(lf.crossfade_frames * hop)
+        stitcher = Stitcher(
+            lf.crossfade_frames * hop,
+            quality_check=self._quality_check_for(plan),
+        )
         pending: "deque" = deque()  # submitted, uncollected futures
         it = iter(plan.chunks)
         first = True

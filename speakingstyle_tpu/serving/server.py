@@ -94,6 +94,7 @@ import numpy as np
 
 from speakingstyle_tpu.configs.config import Config
 from speakingstyle_tpu.obs import JsonlEventLog, build_info, process_rss_bytes
+from speakingstyle_tpu.obs.quality import last_fail as quality_last_fail
 from speakingstyle_tpu.obs.trace import Span, assemble_trace, get_span_ring
 from speakingstyle_tpu.serving import streaming
 from speakingstyle_tpu.serving.batcher import (
@@ -435,6 +436,7 @@ class SynthesisServer:
         # (fleet mode reads the router's set_model_version state instead)
         longform=None,  # LongformService; auto-built when a frontend exists
         slo=None,  # obs.slo.SloEngine; /healthz grows a burn-rate block
+        probes=None,  # serving/probes.GoldenProber; /healthz probe block
     ):
         if engine is None and router is None:
             raise ValueError("SynthesisServer needs an engine or a router")
@@ -442,6 +444,7 @@ class SynthesisServer:
         self.router = router
         self.lifecycle = lifecycle
         self.slo = slo
+        self.probes = probes
         self._model_info = model_info
         self.cfg: Config = router.cfg if router is not None else engine.cfg
         serve = self.cfg.serve
@@ -459,6 +462,17 @@ class SynthesisServer:
         if frontend is not None and getattr(frontend, "style", None) is None:
             frontend.style = self.style
         self.events = events
+        # the HTTP boundary's own validator gate (obs/quality.py): the
+        # engine choke points already validated every wav on the way up;
+        # this one turns a failed verdict into a structured 500 with an
+        # X-Audio-Quality header instead of shipping the bytes
+        from speakingstyle_tpu.obs.quality import QualityGate
+
+        self.quality_gate = QualityGate(
+            getattr(serve, "quality", None),
+            self.cfg.preprocess.preprocessing.audio.sampling_rate,
+            registry=self.registry, events=events,
+        )
         if router is not None:
             self.batcher = None
             self.backend = router
@@ -482,6 +496,7 @@ class SynthesisServer:
                     "fault_plan", None,
                 ),
                 registry=self.registry, events=events,
+                quality=self.quality_gate,
             )
         self.longform = longform
         # frontend overlap (serving/frontend.py): with workers > 0 the
@@ -827,6 +842,23 @@ class SynthesisServer:
                         "mel": result.mel.tolist(),
                     }, req_id=req_id, headers=extra_hdr or None,
                         trace_id=trace_id)
+                # the last gate before bytes leave the process: the
+                # engine's attached verdict (or a fresh check when the
+                # backend predates the choke point) — a failed wav is a
+                # structured 500, never an audio/wav body
+                verdict = outer.quality_gate.check_result(result)
+                if verdict is not None and not verdict.ok:
+                    reasons = ",".join(verdict.reasons)
+                    outer._request_done(req_id, parsed.path, 500, t0,
+                                        served_by=served_by,
+                                        trace_id=trace_id)
+                    return self._json(500, {
+                        "error": "audio quality check failed",
+                        "id": req_id,
+                        "reasons": list(verdict.reasons),
+                    }, req_id=req_id,
+                        headers={"X-Audio-Quality": f"fail:{reasons}"},
+                        trace_id=trace_id)
                 sr = outer.cfg.preprocess.preprocessing.audio.sampling_rate
                 body = wav_bytes(result.wav, sr)
                 outer._request_done(req_id, parsed.path, 200, t0,
@@ -851,8 +883,41 @@ class SynthesisServer:
             def _stream_response(self, result, req_id, parsed, t0,
                                  trace_id=None):
                 """Chunked audio/wav: streaming RIFF header, then PCM in
-                overlap-trimmed windows as each is vocoded."""
+                overlap-trimmed windows as each is vocoded.
+
+                The FIRST window is pulled and re-validated before any
+                header goes on the wire (the long-form handler's idiom),
+                so a stream whose very first chunk fails the quality
+                gate is a clean JSON 500 with ``X-Audio-Quality``
+                instead of a committed audio/wav response."""
                 sr = outer.cfg.preprocess.preprocessing.audio.sampling_rate
+                chunks = outer.stream_chunks(result, arrival=t0)
+                try:
+                    first = next(chunks, None)
+                except Exception as e:
+                    outer._request_done(req_id, parsed.path, 500, t0,
+                                        trace_id=trace_id)
+                    return self._json(500, {"error": str(e), "id": req_id},
+                                      req_id=req_id, trace_id=trace_id)
+                if first is not None:
+                    # record=False: the vocode_collect choke point
+                    # already counted this window — this check only
+                    # decides the response shape
+                    verdict = outer.quality_gate.check(
+                        first, klass=getattr(result, "priority", None),
+                        source="server", record=False,
+                    )
+                    if not verdict.ok:
+                        reasons = ",".join(verdict.reasons)
+                        outer._request_done(req_id, parsed.path, 500, t0,
+                                            trace_id=trace_id)
+                        return self._json(500, {
+                            "error": "audio quality check failed",
+                            "id": req_id,
+                            "reasons": list(verdict.reasons),
+                        }, req_id=req_id,
+                            headers={"X-Audio-Quality": f"fail:{reasons}"},
+                            trace_id=trace_id)
 
                 def write_chunk(data: bytes):
                     self.wfile.write(b"%X\r\n" % len(data))
@@ -878,7 +943,9 @@ class SynthesisServer:
                 try:
                     with outer.stream_scope():
                         write_chunk(wav_stream_header(sr))
-                        for wav in outer.stream_chunks(result, arrival=t0):
+                        if first is not None:
+                            write_chunk(first.tobytes())
+                        for wav in chunks:
                             write_chunk(wav.tobytes())
                     self.wfile.write(b"0\r\n\r\n")
                 except (BrokenPipeError, ConnectionResetError):
@@ -929,6 +996,18 @@ class SynthesisServer:
                     plan = outer.longform.admit(req_id, payload)
                     pieces = outer.longform.stream(plan)
                     first = next(pieces, None)
+                    if first is not None:
+                        # record=False: the Stitcher's choke point
+                        # already counted this piece — this re-check
+                        # only keeps a bad chapter off the wire
+                        verdict = outer.quality_gate.check(
+                            first, source="server", record=False,
+                        )
+                        if not verdict.ok:
+                            reasons = ",".join(verdict.reasons)
+                            status = 500
+                            err = "audio quality check failed: " + reasons
+                            headers = {"X-Audio-Quality": f"fail:{reasons}"}
                 except RequestTooLarge as e:
                     # past even the long-form admission cap
                     status, err = 413, str(e)
@@ -1403,6 +1482,18 @@ class SynthesisServer:
         # burn rates + whether the multi-window alert is firing
         if self.slo is not None:
             out["slo"] = self.slo.status()
+        # the audio-quality plane: validator tallies + the last failure
+        # in this process, probe freshness/drift when a GoldenProber is
+        # wired, and the quality SLO stream's burn view
+        quality: Dict = {"validators": dict(self.quality_gate.status())}
+        last = quality_last_fail()
+        if last is not None:
+            quality["last_fail"] = last
+        if self.probes is not None:
+            quality["probes"] = self.probes.status()
+        if self.slo is not None and hasattr(self.slo, "quality_status"):
+            quality["slo"] = self.slo.quality_status()
+        out["quality"] = quality
         # present only when an Autoscaler is driving scale_to(): the
         # policy's last target plus its decision tally by reason
         if "serve_autoscale_target" in gauges:

@@ -151,6 +151,10 @@ def stream_wav(
     # records one span covering its dispatch→collect life, so the
     # assembled trace shows the depth-k pipeline's actual overlap
     trace = getattr(result, "trace", None)
+    # the traffic class rides too: the quality choke point inside
+    # vocode_collect accounts each window under the owning request's
+    # class (obs/quality.py)
+    klass = getattr(result, "priority", None)
     # (handle, emit_start, emit_end, ctx_start, t0_wall, t0_mono):
     # wall stamp is the span's cross-process start_ts, the monotonic
     # twin measures its duration (JL009)
@@ -171,8 +175,8 @@ def stream_wav(
             int(result.mel_len), window, overlap
         ):
             pending.append(
-                (engine.vocode_dispatch(mel[lo:hi]), start, end, lo,
-                 time.time(), time.monotonic())
+                (engine.vocode_dispatch(mel[lo:hi], klass=klass, trace=trace),
+                 start, end, lo, time.time(), time.monotonic())
             )
             if len(pending) >= depth:
                 yield collect_one()

@@ -461,6 +461,26 @@ class StyleService:
     ) -> StyleVectors:
         return self.encode_mels([mel], keys=[key], speaker=speaker)[0]
 
+    def encode_live(
+        self, mel: np.ndarray, speaker: Optional[str] = None
+    ) -> StyleVectors:
+        """Cache-BYPASSING single-reference encode: always a fresh
+        device round-trip through the precompiled lattice, never read
+        from or inserted into the content-addressed cache.
+
+        The golden prober's style-drift path (serving/probes.py): a
+        cached healthy vector would mask encoder drift exactly when the
+        probe needs to see it, and ``_insert``'s existing-entry
+        preference would discard the drifted values on the way out.
+        Tenant traffic should never use this — it pays a device dispatch
+        on every call.
+        """
+        m = np.asarray(mel, np.float32)
+        _, r = self.lattice.cover(1, m.shape[0])
+        return self._encode_chunk(
+            [m], r, speaker, [self.digest_mel(m)], insert=False
+        )[0]
+
     def encode_wav_bytes(
         self, data: bytes, speaker: Optional[str] = None
     ) -> StyleVectors:
@@ -488,9 +508,11 @@ class StyleService:
         r: int,
         speaker: Optional[str],
         chunk_keys: List[str],
+        insert: bool = True,
     ) -> List[StyleVectors]:
         """One padded encoder dispatch: compile-on-miss (counted, under
-        the lock), pad, execute, read back, insert into the cache.
+        the lock), pad, execute, read back, insert into the cache
+        (``insert=False`` skips the cache entirely — the probe path).
 
         A failed encode never poisons the content-addressed cache:
         ``_insert`` only runs after a successful device round-trip, so
@@ -548,11 +570,12 @@ class StyleService:
         ).observe(time.monotonic() - t0)
         out = []
         for i, (key, mel) in enumerate(zip(chunk_keys, mels)):
-            out.append(self._insert(StyleVectors(
+            entry = StyleVectors(
                 key=key,
                 gamma=gammas[i].copy(),
                 beta=betas[i].copy(),
                 ref_frames=int(mel.shape[0]),
                 speaker=speaker,
-            )))
+            )
+            out.append(self._insert(entry) if insert else entry)
         return out

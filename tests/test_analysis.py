@@ -1438,6 +1438,87 @@ def test_jl026_tree_baseline_is_zero():
 
 
 # ---------------------------------------------------------------------------
+# JL027 — audio bytes leaving serving code without the quality choke point
+# ---------------------------------------------------------------------------
+
+
+def test_jl027_positive_each_emission_shape():
+    # the three emission spellings: float->int16 PCM conversion, RIFF
+    # container build, audio-named buffer serialization — each in a
+    # function with no validator evidence
+    src = """
+        import numpy as np
+
+        def collect(self, wav_f):
+            wav = wav_f.astype(np.int16)
+            return wav
+
+        def container(wav):
+            return wav_bytes(wav, 22050)
+
+        def push(self, chunk):
+            self.sock.send(chunk.tobytes())
+    """
+    found = [
+        f for f in linter.lint_source(textwrap.dedent(src), _SERVING_PATH)
+        if f.rule == "JL027"
+    ]
+    assert len(found) == 3
+    details = " | ".join(f.detail for f in found)
+    assert ".astype(int16)" in details
+    assert "wav_bytes(...)" in details
+    assert "chunk.tobytes()" in details
+
+
+def test_jl027_negative_validated_paths_and_scope():
+    # a quality-gate call in the same function sanctions its emissions
+    assert "JL027" not in _codes("""
+        import numpy as np
+
+        def collect(self, wav_f, klass):
+            wav = wav_f.astype(np.int16)
+            self.quality.check(wav, klass=klass, source="stream")
+            return wav
+    """, path=_SERVING_PATH)
+    # validator evidence in an ENCLOSING function sanctions a helper
+    # closure's emission (the handler validated what the closure ships)
+    assert "JL027" not in _codes("""
+        import numpy as np
+
+        def handler(self, wav_f):
+            def ship(w):
+                return w.astype(np.int16)
+            validate_wav(wav_f, 22050, self.qcfg)
+            return ship(wav_f)
+    """, path=_SERVING_PATH)
+    # a generic buffer serialization is not audio; non-serving paths
+    # are out of scope
+    assert "JL027" not in _codes("""
+        def pack(a):
+            return a.tobytes()
+    """, path=_SERVING_PATH)
+    assert "JL027" not in _codes("""
+        import numpy as np
+
+        def collect(wav_f):
+            return wav_f.astype(np.int16)
+    """, path="speakingstyle_tpu/training/fake.py")
+
+
+def test_jl027_tree_baseline_is_zero():
+    """The every-wav-crosses-the-gate claim, structurally: each audio
+    emission site in serving/ sits in a function that also passes the
+    buffer through obs/quality.py — so the validators, the quality SLO
+    stream, and the golden-probe drill see every path."""
+    findings = [f for f in linter.lint_paths() if f.rule == "JL027"]
+    assert findings == [], (
+        "JL027 must stay at zero tree findings — every audio emission "
+        f"goes through the quality choke point: "
+        f"{[f.fingerprint for f in findings]}"
+    )
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 
@@ -1641,6 +1722,8 @@ def test_cli_check_exits_zero_on_repo():
     ("JL026", "def handle(registry, req_id):\n"
               "    registry.counter(\"serve_requests_total\",\n"
               "                     labels={\"req\": req_id}).inc()\n"),
+    ("JL027", "import numpy as np\n\ndef collect(wav_f):\n"
+              "    return wav_f.astype(np.int16)\n"),
 ])
 def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
     # JL004 is scoped to training/ paths; JL007 to speakingstyle_tpu/;
@@ -1648,7 +1731,7 @@ def test_cli_exits_nonzero_on_each_positive_fixture(tmp_path, code, src):
     # speakingstyle_tpu/serving/; JL017 to both training/ and serving/
     # (training default suffices)
     sub = ("serving" if code in ("JL011", "JL012", "JL013", "JL015", "JL016",
-                                 "JL019", "JL024", "JL026")
+                                 "JL019", "JL024", "JL026", "JL027")
            else "training")
     d = tmp_path / "speakingstyle_tpu" / sub
     d.mkdir(parents=True)
